@@ -1,0 +1,19 @@
+"""kyverno-tpu: a TPU-native policy engine with the capabilities of Kyverno.
+
+Declarative validate / mutate / generate / verifyImages policies over
+Kubernetes resources. The core compiles the policy set into flat pattern
+tensors and evaluates the policy x resource matrix as a vectorized NFA under
+JAX/XLA; a faithful pure-Python tier behind the same ``engine.Backend``
+interface is the correctness oracle and fallback lane.
+
+Layer map (mirrors SURVEY.md section 1):
+  - ``kyverno_tpu.api``       policy CRD types + loaders (L0)
+  - ``kyverno_tpu.engine``    pure policy engine, CPU oracle tier (L3)
+  - ``kyverno_tpu.models``    policy IR + compiler -> pattern tensors
+  - ``kyverno_tpu.ops``       JAX/pallas kernels (wildcard NFA, verdicts)
+  - ``kyverno_tpu.parallel``  mesh sharding of the policy x resource matrix
+  - ``kyverno_tpu.runtime``   webhook server, controllers, reports, metrics
+  - ``kyverno_tpu.cli``       apply / test / validate commands
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""The policy engine: pure functions of (policy, resource, context) -> response.
+
+This is the CPU oracle tier. The accelerated tier (``kyverno_tpu.models`` +
+``kyverno_tpu.ops``) compiles the same semantics into batched JAX kernels and
+is cross-checked against this package test-for-test.
+"""
+
+from .response import EngineResponse, RuleResponse, RuleStatus, RuleType
+
+__all__ = ["EngineResponse", "RuleResponse", "RuleStatus", "RuleType"]

@@ -1,0 +1,203 @@
+"""Match/exclude semantics tests (mirrors pkg/engine/utils_test.go scenarios)."""
+
+from kyverno_tpu.api.types import Rule
+from kyverno_tpu.engine.match import (
+    AdmissionUserInfo,
+    RequestInfo,
+    check_kind,
+    matches_resource_description,
+)
+
+
+def rule(match=None, exclude=None, name="r"):
+    return Rule.from_dict({"name": name, "match": match or {}, "exclude": exclude or {}})
+
+
+POD = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {
+        "name": "nginx",
+        "namespace": "prod",
+        "labels": {"app": "nginx", "tier": "web"},
+        "annotations": {"owner": "team-a"},
+    },
+}
+
+
+class TestCheckKind:
+    def test_plain(self):
+        assert check_kind(["Pod"], POD)
+        assert check_kind(["pod"], POD)  # strings.Title normalization
+        assert not check_kind(["Deployment"], POD)
+
+    def test_star(self):
+        assert check_kind(["*"], POD)
+
+    def test_version_kind(self):
+        assert check_kind(["v1/Pod"], POD)
+        assert not check_kind(["v2/Pod"], POD)
+
+    def test_group_version_kind(self):
+        deploy = {"apiVersion": "apps/v1", "kind": "Deployment"}
+        assert check_kind(["apps/v1/Deployment"], deploy)
+        assert check_kind(["apps/*/Deployment"], deploy)
+        assert not check_kind(["batch/v1/Deployment"], deploy)
+
+
+class TestMatch:
+    def test_kind_match(self):
+        r = rule({"resources": {"kinds": ["Pod"]}})
+        ok, _ = matches_resource_description(POD, r)
+        assert ok
+
+    def test_kind_no_match(self):
+        r = rule({"resources": {"kinds": ["Service"]}})
+        ok, _ = matches_resource_description(POD, r)
+        assert not ok
+
+    def test_name_wildcard(self):
+        r = rule({"resources": {"kinds": ["Pod"], "name": "ngi*"}})
+        assert matches_resource_description(POD, r)[0]
+        r2 = rule({"resources": {"kinds": ["Pod"], "name": "redis*"}})
+        assert not matches_resource_description(POD, r2)[0]
+
+    def test_names_list(self):
+        r = rule({"resources": {"kinds": ["Pod"], "names": ["a", "nginx"]}})
+        assert matches_resource_description(POD, r)[0]
+
+    def test_namespaces(self):
+        r = rule({"resources": {"kinds": ["Pod"], "namespaces": ["prod"]}})
+        assert matches_resource_description(POD, r)[0]
+        r2 = rule({"resources": {"kinds": ["Pod"], "namespaces": ["dev*"]}})
+        assert not matches_resource_description(POD, r2)[0]
+
+    def test_selector(self):
+        r = rule(
+            {"resources": {"kinds": ["Pod"], "selector": {"matchLabels": {"app": "nginx"}}}}
+        )
+        assert matches_resource_description(POD, r)[0]
+        r2 = rule(
+            {"resources": {"kinds": ["Pod"], "selector": {"matchLabels": {"app": "redis"}}}}
+        )
+        assert not matches_resource_description(POD, r2)[0]
+
+    def test_selector_wildcard(self):
+        r = rule(
+            {"resources": {"kinds": ["Pod"], "selector": {"matchLabels": {"app*": "?*"}}}}
+        )
+        assert matches_resource_description(POD, r)[0]
+
+    def test_selector_expressions(self):
+        r = rule(
+            {
+                "resources": {
+                    "kinds": ["Pod"],
+                    "selector": {
+                        "matchExpressions": [
+                            {"key": "tier", "operator": "In", "values": ["web", "api"]}
+                        ]
+                    },
+                }
+            }
+        )
+        assert matches_resource_description(POD, r)[0]
+
+    def test_annotations(self):
+        r = rule({"resources": {"kinds": ["Pod"], "annotations": {"owner": "team-*"}}})
+        assert matches_resource_description(POD, r)[0]
+
+    def test_empty_match_fails(self):
+        assert not matches_resource_description(POD, rule())[0]
+
+    def test_any_or(self):
+        r = rule(
+            {
+                "any": [
+                    {"resources": {"kinds": ["Service"]}},
+                    {"resources": {"kinds": ["Pod"]}},
+                ]
+            }
+        )
+        assert matches_resource_description(POD, r)[0]
+
+    def test_all_and(self):
+        r = rule(
+            {
+                "all": [
+                    {"resources": {"kinds": ["Pod"]}},
+                    {"resources": {"namespaces": ["prod"]}},
+                ]
+            }
+        )
+        assert matches_resource_description(POD, r)[0]
+        r2 = rule(
+            {
+                "all": [
+                    {"resources": {"kinds": ["Pod"]}},
+                    {"resources": {"namespaces": ["dev"]}},
+                ]
+            }
+        )
+        assert not matches_resource_description(POD, r2)[0]
+
+
+class TestExclude:
+    def test_exclude_namespace(self):
+        r = rule(
+            {"resources": {"kinds": ["Pod"]}},
+            {"resources": {"namespaces": ["prod"]}},
+        )
+        assert not matches_resource_description(POD, r)[0]
+
+    def test_exclude_not_matching(self):
+        r = rule(
+            {"resources": {"kinds": ["Pod"]}},
+            {"resources": {"namespaces": ["kube-system"]}},
+        )
+        assert matches_resource_description(POD, r)[0]
+
+    def test_exclude_cluster_role(self):
+        r = rule(
+            {"resources": {"kinds": ["Pod"]}},
+            {"clusterRoles": ["cluster-admin"]},
+        )
+        info = RequestInfo(cluster_roles=["cluster-admin"])
+        assert not matches_resource_description(POD, r, info)[0]
+        info2 = RequestInfo(cluster_roles=["viewer"])
+        assert matches_resource_description(POD, r, info2)[0]
+
+
+class TestUserInfo:
+    def test_subject_service_account(self):
+        r = rule(
+            {
+                "resources": {"kinds": ["Pod"]},
+                "subjects": [{"kind": "ServiceAccount", "namespace": "kube-system", "name": "builder"}],
+            }
+        )
+        info = RequestInfo(
+            admission_user_info=AdmissionUserInfo(
+                username="system:serviceaccount:kube-system:builder"
+            )
+        )
+        assert matches_resource_description(POD, r, info)[0]
+        info2 = RequestInfo(admission_user_info=AdmissionUserInfo(username="alice"))
+        assert not matches_resource_description(POD, r, info2)[0]
+
+    def test_empty_admission_info_skips_userinfo(self):
+        r = rule(
+            {
+                "resources": {"kinds": ["Pod"]},
+                "clusterRoles": ["cluster-admin"],
+            }
+        )
+        # background scan: no admission info -> userInfo constraint dropped
+        assert matches_resource_description(POD, r)[0]
+
+    def test_namespaced_policy(self):
+        r = rule({"resources": {"kinds": ["Pod"]}})
+        ok, _ = matches_resource_description(POD, r, policy_namespace="other")
+        assert not ok
+        ok2, _ = matches_resource_description(POD, r, policy_namespace="prod")
+        assert ok2

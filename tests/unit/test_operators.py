@@ -1,0 +1,162 @@
+"""Condition operator tests (mirrors variables/evaluate_test.go scenarios)."""
+
+from kyverno_tpu.engine.operators import evaluate_condition as ev, evaluate_conditions
+
+
+class TestEquals:
+    def test_scalars(self):
+        assert ev(True, "Equals", True)
+        assert not ev(True, "Equals", False)
+        assert ev(5, "Equals", 5)
+        assert ev(5, "Equals", "5")
+        assert ev(5.0, "Equals", 5)
+        assert ev("abc", "Equals", "abc")
+        assert not ev("abc", "NotEquals", "abc")
+        assert ev("abc", "NotEquals", "abd")
+
+    def test_value_is_wildcard(self):
+        assert ev("nginx:latest", "Equals", "*:latest")
+        assert not ev("*:latest", "Equals", "nginx:latest")  # key is not the pattern
+
+    def test_quantity(self):
+        assert ev("1Gi", "Equals", "1024Mi")
+        assert ev("100m", "Equals", "0.1")
+
+    def test_duration(self):
+        assert ev("1h", "Equals", "60m")
+        assert ev("1h", "Equals", 3600)
+
+    def test_deep(self):
+        assert ev({"a": [1, 2]}, "Equals", {"a": [1, 2]})
+        assert not ev({"a": [1, 2]}, "Equals", {"a": [2, 1]})
+        assert ev([1, "x"], "Equals", [1, "x"])
+
+    def test_case_insensitive_operator(self):
+        assert ev(5, "equals", 5)
+        assert ev(5, "EQUALS", 5)
+
+
+class TestInFamily:
+    def test_in_string_key(self):
+        assert ev("a", "In", ["a", "b"])
+        assert not ev("c", "In", ["a", "b"])
+        assert ev("nginx:*", "In", ["nginx:latest"])  # key is wildcard over items
+        assert ev("c", "NotIn", ["a", "b"])
+
+    def test_in_json_encoded_value(self):
+        assert ev("a", "In", '["a", "b"]')
+        assert not ev("c", "In", '["a", "b"]')
+
+    def test_in_list_key_subset(self):
+        assert ev(["a", "b"], "In", ["a", "b", "c"])
+        assert not ev(["a", "z"], "In", ["a", "b", "c"])
+
+    def test_anyin(self):
+        assert ev(["a", "z"], "AnyIn", ["a", "b"])
+        assert not ev(["y", "z"], "AnyIn", ["a", "b"])
+        assert ev("a", "AnyIn", ["a", "b"])
+        assert ev(5, "AnyIn", ["5", "6"])
+
+    def test_allin(self):
+        assert ev(["a", "b"], "AllIn", ["a", "b", "c"])
+        assert not ev(["a", "z"], "AllIn", ["a", "b", "c"])
+
+    def test_anynotin(self):
+        assert ev(["a", "z"], "AnyNotIn", ["a", "b"])
+        assert not ev(["a", "b"], "AnyNotIn", ["a", "b"])
+
+    def test_allnotin(self):
+        assert ev(["y", "z"], "AllNotIn", ["a", "b"])
+        assert not ev(["a", "z"], "AllNotIn", ["a", "b"])
+
+    def test_wildcards_in_membership(self):
+        assert ev(["run*"], "AllIn", ["runc", "dockerd"])
+        assert ev(["run*"], "AllNotIn", ["containerd"])
+
+    def test_numeric_keys_sprint_coerce(self):
+        # in.go:34 et al: numeric keys stringify before membership checks
+        assert ev(5, "In", [5])
+        assert not ev(5, "NotIn", [5])
+        assert ev(5, "AllNotIn", ["4"])
+        assert ev([80, 443], "AnyIn", ["80"])
+
+    def test_single_element_key_special_case(self):
+        # setExistsInArray short-circuits len(key)==1 && key[0]==value to
+        # "exists" BEFORE the notIn flag applies — quirk preserved
+        assert ev(["a"], "AllIn", "a")
+        assert ev(["a"], "NotIn", "a")
+        assert ev(["a"], "AnyNotIn", "a")
+        assert ev(["a"], "AllNotIn", "a")
+
+    def test_quantifier_boundaries(self):
+        assert ev(["x", "y"], "AnyIn", ["y", "z"])
+        assert not ev(["x", "y"], "AllIn", ["y", "z"])
+        assert ev(["x", "y"], "AnyNotIn", ["y", "z"])
+        assert not ev(["y"], "AllNotIn", ["y", "z"])
+
+
+class TestNumeric:
+    def test_numbers(self):
+        assert ev(10, "GreaterThan", 5)
+        assert not ev(5, "GreaterThan", 10)
+        assert ev(5, "GreaterThanOrEquals", 5)
+        assert ev(5, "LessThanOrEquals", 5)
+        assert ev(3, "LessThan", 5)
+        assert ev(10, "GreaterThan", "5")
+        assert ev("10", "GreaterThan", 5)
+
+    def test_quantities(self):
+        assert ev("2Gi", "GreaterThan", "1Gi")
+        assert ev("500Mi", "LessThan", "1Gi")
+        assert ev("1Gi", "GreaterThanOrEquals", "1024Mi")
+
+    def test_durations(self):
+        assert ev("2h", "GreaterThan", "90m")
+        assert ev("30m", "LessThan", "1h")
+        assert ev("1h", "DurationGreaterThan", "30m")
+        assert ev(7200, "DurationGreaterThan", "1h")
+
+    def test_string_key_parse_order(self):
+        # numeric.go:144: float key parse happens before quantity, so a bare
+        # numeric key never quantity-compares against a suffixed value
+        assert not ev("2", "LessThan", "1Gi")
+        # non-crash on unparseable value against quantity key
+        assert not ev("10Gi", "GreaterThan", float("inf"))
+
+
+class TestAnyAll:
+    def test_bare_list_is_and(self):
+        conds = [
+            {"key": 1, "operator": "Equals", "value": 1},
+            {"key": 2, "operator": "Equals", "value": 2},
+        ]
+        assert evaluate_conditions(conds)
+        conds[1]["value"] = 3
+        assert not evaluate_conditions(conds)
+
+    def test_any(self):
+        conds = {
+            "any": [
+                {"key": 1, "operator": "Equals", "value": 2},
+                {"key": 2, "operator": "Equals", "value": 2},
+            ]
+        }
+        assert evaluate_conditions(conds)
+
+    def test_all(self):
+        conds = {
+            "all": [
+                {"key": 1, "operator": "Equals", "value": 1},
+                {"key": 2, "operator": "Equals", "value": 3},
+            ]
+        }
+        assert not evaluate_conditions(conds)
+
+    def test_any_and_all_combined(self):
+        conds = {
+            "any": [{"key": 1, "operator": "Equals", "value": 1}],
+            "all": [{"key": 2, "operator": "Equals", "value": 2}],
+        }
+        assert evaluate_conditions(conds)
+        conds["all"][0]["value"] = 3
+        assert not evaluate_conditions(conds)

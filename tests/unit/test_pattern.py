@@ -1,0 +1,130 @@
+"""Leaf comparator tests, mirroring the reference table-driven suites in
+pkg/engine/validate/pattern_test.go semantics."""
+
+import pytest
+
+from kyverno_tpu.engine.pattern import Op, get_operator, validate_value_with_pattern as vvp
+
+
+class TestOperators:
+    @pytest.mark.parametrize(
+        "pattern,op",
+        [
+            ("", Op.EQUAL),
+            ("x", Op.EQUAL),
+            (">=1", Op.MORE_EQUAL),
+            ("<=10Gi", Op.LESS_EQUAL),
+            (">5", Op.MORE),
+            ("<5", Op.LESS),
+            ("!latest", Op.NOT_EQUAL),
+            ("1-10", Op.IN_RANGE),
+            ("1!-10", Op.NOT_IN_RANGE),
+            ("10Mi-20Mi", Op.IN_RANGE),
+            ("10Mi!-20Mi", Op.NOT_IN_RANGE),
+            ("abc-def", Op.EQUAL),  # no leading digits -> not a range
+            ("1.5.7", Op.EQUAL),
+        ],
+    )
+    def test_get_operator(self, pattern, op):
+        assert get_operator(pattern) == op
+
+
+class TestScalars:
+    def test_bool(self):
+        assert vvp(True, True)
+        assert not vvp(False, True)
+        assert not vvp("true", True)
+        assert not vvp(1, True)
+
+    def test_int_pattern(self):
+        assert vvp(5, 5)
+        assert not vvp(6, 5)
+        assert vvp(5.0, 5)
+        assert not vvp(5.5, 5)
+        assert vvp("5", 5)
+        assert not vvp("5x", 5)
+        assert not vvp(True, 1)
+
+    def test_float_pattern(self):
+        assert vvp(5.5, 5.5)
+        assert vvp(5, 5.0)
+        assert not vvp(5, 5.5)
+        assert vvp("5.5", 5.5)
+        assert not vvp("abc", 5.5)
+
+    def test_nil_pattern(self):
+        assert vvp(None, None)
+        assert vvp(0, None)
+        assert vvp(0.0, None)
+        assert vvp("", None)
+        assert vvp(False, None)
+        assert not vvp(1, None)
+        assert not vvp({"a": 1}, None)
+        assert not vvp([1], None)
+
+    def test_map_pattern_existence_only(self):
+        assert vvp({"a": 1}, {"x": "ignored"})
+        assert not vvp("notamap", {"x": 1})
+
+    def test_array_pattern_unsupported(self):
+        assert not vvp([1, 2], [1, 2])
+
+
+class TestStringPatterns:
+    def test_wildcard_equality(self):
+        assert vvp("nginx:latest", "*:latest")
+        assert not vvp("nginx:1.21", "*:latest")
+        assert vvp("nginx:1.21", "!*:latest")
+        assert not vvp("nginx:latest", "!*:latest")
+        assert vvp("anything", "*")
+
+    def test_or_patterns(self):
+        assert vvp("a", "a|b")
+        assert vvp("b", "a|b")
+        assert not vvp("c", "a|b")
+        assert vvp("nginx:v1", "*:v1 | *:v2")
+        assert vvp("nginx:v2", "*:v1 | *:v2")
+
+    def test_and_patterns(self):
+        assert vvp("nginx-prod", "nginx-* & *-prod")
+        assert not vvp("nginx-dev", "nginx-* & *-prod")
+
+    def test_numeric_comparisons(self):
+        assert vvp(10, ">5")
+        assert not vvp(3, ">5")
+        assert vvp(5, ">=5")
+        assert vvp(3, "<5")
+        assert vvp(5, "<=5")
+        assert not vvp(6, "<=5")
+        assert vvp("10", ">5")
+
+    def test_quantity_comparisons(self):
+        assert vvp("100Mi", "<1Gi")
+        assert not vvp("2Gi", "<1Gi")
+        assert vvp("1024Mi", "1Gi")
+        assert vvp("2", ">1500m")
+        assert vvp("100m", "<1")
+
+    def test_ranges(self):
+        assert vvp(5, "1-10")
+        assert vvp(1, "1-10")
+        assert vvp(10, "1-10")
+        assert not vvp(11, "1-10")
+        assert not vvp(5, "1!-10")
+        assert vvp(11, "1!-10")
+        assert vvp(0, "1!-10")
+        assert vvp("512Mi", "100Mi-1Gi")
+        assert not vvp("2Gi", "100Mi-1Gi")
+        assert vvp("2Gi", "100Mi!-1Gi")
+
+    def test_number_string_coercion(self):
+        # int value against numeric-looking string pattern: quantity compare
+        assert vvp(8080, "8080")
+        assert not vvp(8080, "8081")
+        # value stringified for wildcard when pattern is not a quantity
+        assert vvp("v1.2.3", "v1.*")
+        assert vvp(None, "0")  # nil converts to "0" on the numeric path
+
+    def test_inequality_on_strings_fails(self):
+        assert not vvp("abc", ">abc")
+        assert not vvp("abc", "<abc")

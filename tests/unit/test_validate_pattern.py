@@ -1,0 +1,153 @@
+"""Tree matcher tests: map/array recursion, anchors, skip semantics.
+
+Scenarios mirror pkg/engine/validation_test.go fixtures (inline JSON policy
+fragments asserted pass/fail/skip)."""
+
+from kyverno_tpu.engine.validate_pattern import match_pattern
+
+
+def pod(containers=None, **meta):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "test", **meta},
+        "spec": {"containers": containers or []},
+    }
+
+
+class TestBasicMatch:
+    def test_scalar_leaf(self):
+        r = match_pattern({"a": 1}, {"a": 1})
+        assert r.matched
+        r = match_pattern({"a": 1}, {"a": 2})
+        assert not r.matched and not r.skip
+
+    def test_nested_map(self):
+        res = {"spec": {"replicas": 3}}
+        assert match_pattern(res, {"spec": {"replicas": ">2"}}).matched
+        assert not match_pattern(res, {"spec": {"replicas": ">5"}}).matched
+
+    def test_missing_key_fails(self):
+        r = match_pattern({"a": 1}, {"b": 1})
+        assert not r.matched
+
+    def test_structure_mismatch(self):
+        r = match_pattern({"a": [1]}, {"a": {"b": 1}})
+        assert not r.matched
+
+    def test_star_requires_presence(self):
+        assert match_pattern({"a": "x"}, {"a": "*"}).matched
+        assert match_pattern({"a": {"b": 1}}, {"a": "*"}).matched
+        r = match_pattern({"c": 1}, {"a": "*"})
+        assert not r.matched
+
+
+class TestArraySemantics:
+    def test_array_of_maps_all_must_match(self):
+        res = pod([{"image": "nginx:1.21"}, {"image": "redis:6"}])
+        pat = {"spec": {"containers": [{"image": "*:*"}]}}
+        assert match_pattern(res, pat).matched
+
+        res2 = pod([{"image": "nginx:1.21"}, {"image": "redis"}])
+        assert not match_pattern(res2, pat).matched
+
+    def test_disallow_latest_tag(self):
+        pat = {"spec": {"containers": [{"image": "!*:latest"}]}}
+        assert match_pattern(pod([{"image": "nginx:1.21"}]), pat).matched
+        assert not match_pattern(pod([{"image": "nginx:latest"}]), pat).matched
+
+    def test_scalar_pattern_over_array(self):
+        res = {"finalizers": ["a", "b"]}
+        assert match_pattern(res, {"finalizers": ["?"]}).matched
+        assert not match_pattern(res, {"finalizers": ["a"]}).matched  # "b" != "a"
+
+    def test_empty_pattern_array_fails(self):
+        assert not match_pattern({"a": [1]}, {"a": []}).matched
+
+
+class TestConditionAnchor:
+    PAT = {
+        "spec": {
+            "containers": [
+                {"(image)": "*:latest", "imagePullPolicy": "Always"}
+            ]
+        }
+    }
+
+    def test_condition_applies_and_passes(self):
+        res = pod([{"image": "nginx:latest", "imagePullPolicy": "Always"}])
+        assert match_pattern(res, self.PAT).matched
+
+    def test_condition_applies_and_fails(self):
+        res = pod([{"image": "nginx:latest", "imagePullPolicy": "IfNotPresent"}])
+        r = match_pattern(res, self.PAT)
+        assert not r.matched and not r.skip
+
+    def test_condition_not_applicable_skips_element(self):
+        # image is not :latest -> element skipped -> pattern passes
+        res = pod([{"image": "nginx:1.21", "imagePullPolicy": "IfNotPresent"}])
+        assert match_pattern(res, self.PAT).matched
+
+    def test_top_level_condition_skip(self):
+        # condition anchor at map level: mismatch -> whole rule skips
+        pat = {"metadata": {"(name)": "prod-*"}, "spec": {"hostNetwork": False}}
+        res = {"metadata": {"name": "dev-pod"}, "spec": {"hostNetwork": True}}
+        r = match_pattern(res, pat)
+        assert not r.matched and r.skip
+
+    def test_top_level_condition_applies(self):
+        pat = {"metadata": {"(name)": "prod-*"}, "spec": {"hostNetwork": False}}
+        res = {"metadata": {"name": "prod-pod"}, "spec": {"hostNetwork": True}}
+        r = match_pattern(res, pat)
+        assert not r.matched and not r.skip
+
+
+class TestOtherAnchors:
+    def test_equality_anchor(self):
+        pat = {"metadata": {"=(annotations)": {"owner": "?*"}}}
+        # annotations present -> must match
+        assert match_pattern({"metadata": {"annotations": {"owner": "me"}}}, pat).matched
+        assert not match_pattern({"metadata": {"annotations": {"x": "y"}}}, pat).matched
+        # annotations absent -> pass
+        assert match_pattern({"metadata": {}}, pat).matched
+
+    def test_negation_anchor(self):
+        pat = {"spec": {"X(hostNetwork)": "null"}}
+        assert match_pattern({"spec": {}}, pat).matched
+        assert not match_pattern({"spec": {"hostNetwork": True}}, pat).matched
+
+    def test_existence_anchor(self):
+        pat = {"spec": {"^(containers)": [{"name": "istio-proxy"}]}}
+        res = pod([{"name": "app"}, {"name": "istio-proxy"}])
+        assert match_pattern(res, pat).matched
+        res2 = pod([{"name": "app"}])
+        assert not match_pattern(res2, pat).matched
+
+    def test_global_anchor_skips_whole_rule(self):
+        pat = {
+            "spec": {
+                "containers": [
+                    {"<(image)": "registry.corp/*", "securityContext": {"runAsNonRoot": True}}
+                ]
+            }
+        }
+        # image from another registry -> global anchor mismatch -> skip
+        res = pod([{"image": "docker.io/nginx", "securityContext": {"runAsNonRoot": False}}])
+        r = match_pattern(res, pat)
+        assert not r.matched and r.skip
+        # matching registry -> enforced
+        res2 = pod([{"image": "registry.corp/nginx", "securityContext": {"runAsNonRoot": False}}])
+        r2 = match_pattern(res2, pat)
+        assert not r2.matched and not r2.skip
+
+
+class TestMetadataWildcardKeys:
+    def test_label_key_expansion(self):
+        pat = {"metadata": {"labels": {"app.kubernetes.io/*": "?*"}}}
+        res = {"metadata": {"labels": {"app.kubernetes.io/name": "nginx"}}}
+        assert match_pattern(res, pat).matched
+
+    def test_label_key_expansion_no_match(self):
+        pat = {"metadata": {"labels": {"app.kubernetes.io/*": "?*"}}}
+        res = {"metadata": {"labels": {"team": "x"}}}
+        assert not match_pattern(res, pat).matched

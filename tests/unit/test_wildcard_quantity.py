@@ -1,0 +1,95 @@
+from fractions import Fraction
+
+import pytest
+
+from kyverno_tpu.utils.duration import DurationError, parse_duration
+from kyverno_tpu.utils.quantity import QuantityError, parse_quantity
+from kyverno_tpu.utils.wildcard import wildcard_match
+
+
+@pytest.mark.parametrize(
+    "pattern,text,want",
+    [
+        ("*", "", True),
+        ("*", "anything", True),
+        ("", "", True),
+        ("", "x", False),
+        ("*:*", "nginx:latest", True),
+        ("*:*", "nginx", False),
+        ("*:latest", "nginx:latest", True),
+        ("*:latest", "nginx:1.21", False),
+        ("nginx*", "nginx-deployment", True),
+        ("?at", "cat", True),
+        ("?at", "at", False),
+        ("a*b*c", "aXXbYYc", True),
+        ("a*b*c", "acb", False),
+        ("*a*a*a*", "aaa", True),
+        ("*.example.com", "svc.example.com", True),
+        ("ab", "ab", True),
+        ("a?", "ab", True),
+        ("??", "a", False),
+        ("kubernetes.io/*", "kubernetes.io/hostname", True),
+    ],
+)
+def test_wildcard(pattern, text, want):
+    assert wildcard_match(pattern, text) is want
+
+
+@pytest.mark.parametrize(
+    "s,want",
+    [
+        ("1", 1),
+        ("100", 100),
+        ("-5", -5),
+        ("+5", 5),
+        ("1.5", Fraction(3, 2)),
+        ("100m", Fraction(1, 10)),
+        ("1500m", Fraction(3, 2)),
+        ("1Ki", 1024),
+        ("1Mi", 1024 * 1024),
+        ("2Gi", 2 * 1024**3),
+        ("1k", 1000),
+        ("1M", 10**6),
+        ("3e2", 300),
+        ("3E2", 300),
+        ("1E", 10**18),
+        ("0.5Gi", 2**29),
+        (".5", Fraction(1, 2)),
+    ],
+)
+def test_quantity_parse(s, want):
+    assert parse_quantity(s) == Fraction(want)
+
+
+@pytest.mark.parametrize("s", ["", "abc", "1.2.3", "10Xi", "1,000", "--1", "1 Gi", "mi"])
+def test_quantity_invalid(s):
+    with pytest.raises(QuantityError):
+        parse_quantity(s)
+
+
+def test_quantity_cross_suffix_compare():
+    assert parse_quantity("1024Mi") == parse_quantity("1Gi")
+    assert parse_quantity("0.1") == parse_quantity("100m")
+    assert parse_quantity("1Gi") > parse_quantity("900M")
+    assert parse_quantity("500Mi") < parse_quantity("1G")
+
+
+@pytest.mark.parametrize(
+    "s,want",
+    [
+        ("1h", 3600.0),
+        ("1h30m", 5400.0),
+        ("300ms", 0.3),
+        ("-1.5h", -5400.0),
+        ("0", 0.0),
+        ("2s", 2.0),
+    ],
+)
+def test_duration(s, want):
+    assert parse_duration(s) == pytest.approx(want)
+
+
+@pytest.mark.parametrize("s", ["", "1", "1d", "h", "1hh"])
+def test_duration_invalid(s):
+    with pytest.raises(DurationError):
+        parse_duration(s)
