@@ -1,0 +1,238 @@
+"""Context store + variable substitution tests (mirrors vars_test.go and
+context_test.go scenarios)."""
+
+import pytest
+
+from kyverno_tpu.engine.context import Context, extract_image_info, merge_patch, parse_image
+from kyverno_tpu.engine.variables import (
+    NotResolvedReferenceError,
+    VariableResolutionError,
+    substitute_all,
+    substitute_all_force_mutate,
+    substitute_all_in_preconditions,
+    substitute_references,
+)
+
+
+class TestMergePatch:
+    def test_merge(self):
+        assert merge_patch({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+        assert merge_patch({"a": {"x": 1}}, {"a": {"y": 2}}) == {"a": {"x": 1, "y": 2}}
+
+    def test_null_deletes(self):
+        assert merge_patch({"a": 1, "b": 2}, {"a": None}) == {"b": 2}
+
+    def test_arrays_replace(self):
+        assert merge_patch({"a": [1, 2]}, {"a": [3]}) == {"a": [3]}
+
+
+class TestContext:
+    def test_add_resource_and_query(self):
+        ctx = Context()
+        ctx.add_resource({"metadata": {"name": "pod-x"}})
+        assert ctx.query("request.object.metadata.name") == "pod-x"
+
+    def test_checkpoint_restore(self):
+        ctx = Context()
+        ctx.add_resource({"metadata": {"name": "a"}})
+        ctx.checkpoint()
+        ctx.add_json({"request": {"object": {"metadata": {"name": "b"}}}})
+        assert ctx.query("request.object.metadata.name") == "b"
+        ctx.restore()
+        assert ctx.query("request.object.metadata.name") == "a"
+
+    def test_reset_keeps_checkpoint(self):
+        ctx = Context()
+        ctx.add_json({"x": 1})
+        ctx.checkpoint()
+        ctx.add_json({"x": 2})
+        ctx.reset()
+        assert ctx.query("x") == 1
+        ctx.add_json({"x": 3})
+        ctx.reset()
+        assert ctx.query("x") == 1
+
+    def test_service_account(self):
+        ctx = Context()
+        ctx.add_service_account("system:serviceaccount:kube-system:builder")
+        assert ctx.query("serviceAccountName") == "builder"
+        assert ctx.query("serviceAccountNamespace") == "kube-system"
+
+    def test_missing_query_returns_none(self):
+        ctx = Context()
+        assert ctx.query("does.not.exist") is None
+
+    def test_has_changed(self):
+        ctx = Context()
+        ctx.add_resource({"spec": {"replicas": 2}})
+        ctx.add_old_resource({"spec": {"replicas": 1}})
+        assert ctx.has_changed("spec.replicas") is True
+        ctx2 = Context()
+        ctx2.add_resource({"spec": {"replicas": 2}})
+        ctx2.add_old_resource({"spec": {"replicas": 2}})
+        assert ctx2.has_changed("spec.replicas") is False
+
+
+class TestImageInfo:
+    def test_parse_image(self):
+        info = parse_image("nginx")
+        assert info["registry"] == "docker.io"
+        assert info["name"] == "nginx"
+        assert info["tag"] == "latest"
+
+        info = parse_image("quay.io/org/app:v1.2")
+        assert info["registry"] == "quay.io"
+        assert info["path"] == "org/app"
+        assert info["name"] == "app"
+        assert info["tag"] == "v1.2"
+
+        info = parse_image("nginx@sha256:" + "a" * 64)
+        assert info["digest"].startswith("sha256:")
+
+    def test_extract_pod(self):
+        pod = {
+            "kind": "Pod",
+            "spec": {
+                "containers": [{"name": "c1", "image": "nginx:1.21"}],
+                "initContainers": [{"name": "i1", "image": "busybox"}],
+            },
+        }
+        images = extract_image_info(pod)
+        assert images["containers"]["c1"]["tag"] == "1.21"
+        assert images["initContainers"]["i1"]["name"] == "busybox"
+        assert images["containers"]["c1"]["jsonPath"] == "/spec/containers/0/image"
+
+    def test_extract_deployment(self):
+        dep = {
+            "kind": "Deployment",
+            "spec": {"template": {"spec": {"containers": [{"name": "c", "image": "r/a:1"}]}}},
+        }
+        images = extract_image_info(dep)
+        assert images["containers"]["c"]["jsonPath"] == "/spec/template/spec/containers/0/image"
+
+    def test_context_images_query(self):
+        ctx = Context()
+        ctx.add_image_info(
+            {"kind": "Pod", "spec": {"containers": [{"name": "c", "image": "nginx:latest"}]}}
+        )
+        assert ctx.query("images.containers.c.tag") == "latest"
+
+
+class TestVariableSubstitution:
+    def ctx(self):
+        ctx = Context()
+        ctx.add_resource(
+            {
+                "metadata": {"name": "mypod", "namespace": "prod", "labels": {"app": "web"}},
+                "spec": {"replicas": 3},
+            }
+        )
+        return ctx
+
+    def test_simple_substitution(self):
+        doc = {"message": "name is {{request.object.metadata.name}}"}
+        out = substitute_all(self.ctx(), doc)
+        assert out == {"message": "name is mypod"}
+
+    def test_whole_string_keeps_type(self):
+        doc = {"replicas": "{{request.object.spec.replicas}}"}
+        out = substitute_all(self.ctx(), doc)
+        assert out == {"replicas": 3}
+
+    def test_object_substitution_in_string(self):
+        doc = {"msg": "labels: {{request.object.metadata.labels}}"}
+        out = substitute_all(self.ctx(), doc)
+        assert out == {"msg": 'labels: {"app":"web"}'}
+
+    def test_key_substitution(self):
+        doc = {"{{request.object.metadata.name}}-suffix": 1}
+        out = substitute_all(self.ctx(), doc)
+        assert out == {"mypod-suffix": 1}
+
+    def test_escaped_variable(self):
+        doc = {"m": "literal \\{{not.a.var}} kept"}
+        out = substitute_all(self.ctx(), doc)
+        assert out == {"m": "literal {{not.a.var}} kept"}
+
+    def test_nested_variable_resolution(self):
+        ctx = self.ctx()
+        ctx.add_json({"inner": "{{request.object.metadata.name}}"})
+        # partial substitution loops until the nested variable resolves
+        # (vars.go:388 "check for nested variables in strings"); a
+        # whole-string variable returns its value verbatim (vars.go:372)
+        out = substitute_all(ctx, {"m": "x-{{inner}}"})
+        assert out == {"m": "x-mypod"}
+        out2 = substitute_all(ctx, {"m": "{{inner}}"})
+        assert out2 == {"m": "{{request.object.metadata.name}}"}
+
+    def test_preconditions_resolver_empty_on_missing(self):
+        doc = {"key": "{{unknown..bad}}"}
+        out = substitute_all_in_preconditions(self.ctx(), doc)
+        assert out == {"key": ""}
+
+    def test_force_mutate_placeholders(self):
+        doc = {"m": "{{anything.at.all}}", "n": "x"}
+        out = substitute_all_force_mutate(None, doc)
+        assert out == {"m": "placeholderValue", "n": "x"}
+
+    def test_container_substitution_resolves_inner_vars(self):
+        # traverse.go:62-78: the substituted result is itself traversed
+        ctx = self.ctx()
+        ctx.add_json({"cfg": {"n": "{{request.object.metadata.name}}"}})
+        assert substitute_all(ctx, {"v": "{{cfg}}"}) == {"v": {"n": "mypod"}}
+
+    def test_non_string_key_substitution_errors(self):
+        from kyverno_tpu.engine.jsonutils import NonStringKeyError
+
+        ctx = self.ctx()
+        ctx.add_json({"cfg": {"n": 1}})
+        with pytest.raises(NonStringKeyError):
+            substitute_all(ctx, {"{{cfg}}": 1})
+
+    def test_hyphen_variable_fails_cleanly(self):
+        # hyphenated label keys must raise a resolution error, not crash
+        with pytest.raises(VariableResolutionError):
+            substitute_all(self.ctx(), {"m": "{{request.object.metadata.labels.app-name}}"})
+        out = substitute_all_in_preconditions(
+            self.ctx(), {"key": "{{request.object.metadata.labels.app-name}}"}
+        )
+        assert out == {"key": ""}
+
+    def test_delete_request_rewrite(self):
+        ctx = Context()
+        ctx.add_json({"request": {"operation": "DELETE"}})
+        ctx.add_old_resource({"metadata": {"name": "gone"}})
+        out = substitute_all(ctx, {"m": "{{request.object.metadata.name}}"})
+        assert out == {"m": "gone"}
+
+
+class TestReferences:
+    def test_relative_reference(self):
+        # references are relative to the leaf's own path: ../ = sibling
+        doc = {
+            "validate": {
+                "pattern": {
+                    "spec": {"cpu": "4", "limit": "$(../cpu)"}
+                }
+            }
+        }
+        out = substitute_references(doc)
+        assert out["validate"]["pattern"]["spec"]["limit"] == "4"
+
+    def test_parent_reference(self):
+        doc = {"a": {"b": "val", "c": {"d": "$(../../b)"}}}
+        out = substitute_references(doc)
+        assert out["a"]["c"]["d"] == "val"
+
+    def test_reference_with_operator(self):
+        doc = {"spec": {"min": "2", "check": "$(<=../min)"}}
+        out = substitute_references(doc)
+        assert out["spec"]["check"] == "<=2"
+
+    def test_unresolvable_reference_raises(self):
+        with pytest.raises((NotResolvedReferenceError, VariableResolutionError)):
+            substitute_references({"a": "$(./nope)"})
+
+    def test_escaped_reference(self):
+        out = substitute_references({"a": "\\$(keep)"})
+        assert out == {"a": "$(keep)"}

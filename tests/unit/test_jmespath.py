@@ -1,0 +1,136 @@
+"""JMESPath dialect tests: spec behaviors + the 19 kyverno functions."""
+
+import pytest
+
+from kyverno_tpu.engine.jmespath import JMESPathError, search
+
+
+class TestCore:
+    @pytest.mark.parametrize(
+        "expr,data,want",
+        [
+            ("a", {"a": 1}, 1),
+            ("a.b.c", {"a": {"b": {"c": 42}}}, 42),
+            ("a.b", {"a": {}}, None),
+            ("a", [1], None),
+            ("a[0]", {"a": [9]}, 9),
+            ("a[-1]", {"a": [1, 2, 3]}, 3),
+            ("a[5]", {"a": [1]}, None),
+            ("a[1:3]", {"a": [0, 1, 2, 3]}, [1, 2]),
+            ("a[::2]", {"a": [0, 1, 2, 3]}, [0, 2]),
+            ('"weird.key"', {"weird.key": 5}, 5),
+            ("@", {"x": 1}, {"x": 1}),
+            ("`\"literal\"`", {}, "literal"),
+            ("'raw'", {}, "raw"),
+            ("`[1, 2]`", {}, [1, 2]),
+        ],
+    )
+    def test_basics(self, expr, data, want):
+        assert search(expr, data) == want
+
+    def test_projections(self):
+        data = {"a": [{"b": {"c": 1}}, {"b": {"c": 2}}, {"x": 0}]}
+        assert search("a[*].b.c", data) == [1, 2]
+        assert search("a[]", {"a": [[{"b": 1}], [{"b": 2}]]}) == [{"b": 1}, {"b": 2}]
+        assert search("a[].b", {"a": [[{"b": 1}], [{"b": 2}]]}) == [1, 2]
+        assert search("a.*.c", {"a": {"x": {"c": 1}, "y": {"c": 2}}}) == [1, 2]
+        assert search("a[*].b[0]", {"a": [{"b": [7]}]}) == [7]
+
+    def test_filters(self):
+        data = {"items": [{"n": "a", "v": 1}, {"n": "b", "v": 2}]}
+        assert search("items[?v>`1`].n", data) == ["b"]
+        assert search("items[?n=='a'].v", data) == [1]
+        assert search("items[?v>=`1`] | length(@)", data) == 2
+
+    def test_logical(self):
+        assert search("a || b", {"b": 2}) == 2
+        assert search("a && b", {"a": 1, "b": 2}) == 2
+        assert search("!a", {"a": ""}) is True
+        assert search("a == b", {"a": 1, "b": 1}) is True
+        assert search("a != b", {"a": 1, "b": 1}) is False
+        assert search("a < b", {"a": 1, "b": 2}) is True
+
+    def test_multiselect(self):
+        assert search("[a, b]", {"a": 1, "b": 2}) == [1, 2]
+        assert search("{x: a}", {"a": 1}) == {"x": 1}
+        assert search("a.[b, c]", {"a": {"b": 1, "c": 2}}) == [1, 2]
+
+    def test_pipe_stops_projection(self):
+        # projection RHS stops at the pipe: [0] applies to the whole list
+        assert search("a[*].b | [0]", {"a": [{"b": 1}, {"b": 2}]}) == 1
+
+    def test_functions(self):
+        assert search("length(a)", {"a": "xyz"}) == 3
+        assert search("keys(a)", {"a": {"k": 1}}) == ["k"]
+        assert search("sort_by(a, &v)[0].n", {"a": [{"n": "x", "v": 2}, {"n": "y", "v": 1}]}) == "y"
+        assert search("max_by(a, &v).n", {"a": [{"n": "x", "v": 2}, {"n": "y", "v": 1}]}) == "x"
+        assert search("map(&b, a)", {"a": [{"b": 1}, {"b": 2}]}) == [1, 2]
+        assert search("to_number('3')", {}) == 3
+        assert search("starts_with(a, 'ng')", {"a": "nginx"}) is True
+        assert search("merge(a, b)", {"a": {"x": 1}, "b": {"y": 2}}) == {"x": 1, "y": 2}
+        assert search("not_null(a, b)", {"b": 3}) == 3
+
+    def test_unknown_function_raises(self):
+        with pytest.raises(JMESPathError):
+            search("nope(a)", {"a": 1})
+
+    def test_parse_error(self):
+        with pytest.raises(JMESPathError):
+            search("a.[", {})
+
+
+class TestKyvernoDialect:
+    @pytest.mark.parametrize(
+        "expr,want",
+        [
+            ("compare('a', 'b')", -1),
+            ("compare('b', 'a')", 1),
+            ("compare('a', 'a')", 0),
+            ("equal_fold('Abc', 'aBC')", True),
+            ("replace('aaa', 'a', 'b', `2`)", "bba"),
+            ("replace('aaa', 'a', 'b', `-1`)", "bbb"),
+            ("replace_all('a-b-c', '-', '.')", "a.b.c"),
+            ("to_upper('abc')", "ABC"),
+            ("to_lower('ABC')", "abc"),
+            ("trim('xxhixx', 'x')", "hi"),
+            ("split('a,b', ',')", ["a", "b"]),
+            ("regex_match('^v\\d+', 'v123')", True),
+            ("regex_match('^v\\d+$', 'x1')", False),
+            ("regex_replace_all('ab(\\d+)', 'ab123', 'x$1')", "x123"),
+            ("regex_replace_all_literal('\\d+', 'ab123', 'N')", "abN"),
+            ("label_match(`{\"a\":\"1\"}`, `{\"a\":\"1\",\"b\":\"2\"}`)", True),
+            ("label_match(`{\"a\":\"1\"}`, `{\"a\":\"2\"}`)", False),
+            ("add(`3`, `4`)", 7),
+            ("subtract(`3`, `4`)", -1),
+            ("multiply(`3`, `4`)", 12),
+            ("divide(`8`, `2`)", 4),
+            ("modulo(`7`, `3`)", 1),
+            ("base64_encode('hello')", "aGVsbG8="),
+            ("base64_decode('aGVsbG8=')", "hello"),
+        ],
+    )
+    def test_functions(self, expr, want):
+        assert search(expr, {}) == want
+
+    def test_divide_by_zero(self):
+        with pytest.raises(JMESPathError):
+            search("divide(`1`, `0`)", {})
+
+    def test_number_coercion_in_regex(self):
+        assert search("regex_match('^12$', `12`)", {}) is True
+
+    def test_missing_regex_group_expands_empty(self):
+        # Go ReplaceAllString expands unknown $N to "" instead of erroring
+        assert search("regex_replace_all('cost', 'cost: 10', '$9.99')", {}) == ".99: 10"
+        assert search("regex_replace_all('x', 'x', '$$lit')", {}) == "$lit"
+
+    def test_literal_replacement_keeps_dollars_and_backslashes(self):
+        assert search("regex_replace_all_literal('\\d+', 'ab12', '$1\\x')", {}) == "ab$1\\x"
+
+    def test_hyphen_identifier_is_parse_error(self):
+        with pytest.raises(JMESPathError):
+            search("foo-bar", {})
+
+    def test_to_array_null_wraps(self):
+        assert search("to_array(`null`)", {}) == [None]
+        assert search("length(to_array(`null`))", {}) == 1
