@@ -110,8 +110,8 @@ class Context:
     # ------------------------------------------------------------ queries
 
     def query(self, query: str):
-        """context/evaluate.go:15. Missing paths return None; malformed
-        queries raise."""
+        """context/evaluate.go:15. Missing map keys and malformed queries
+        raise InvalidVariableError (fork semantics, see interpreter._field)."""
         query = (query or "").strip()
         if not query:
             raise InvalidVariableError("invalid query (empty)")
@@ -121,13 +121,9 @@ class Context:
             raise InvalidVariableError(f"incorrect query {query!r}: {e}") from e
 
     def has_changed(self, jmespath_expr: str) -> bool:
-        """context/evaluate.go:52."""
+        """context/evaluate.go:52. Missing paths raise from query()."""
         obj = self.query(f"request.object.{jmespath_expr}")
-        if obj is None:
-            raise InvalidVariableError(f"request.object.{jmespath_expr} not found")
         old = self.query(f"request.oldObject.{jmespath_expr}")
-        if old is None:
-            raise InvalidVariableError(f"request.oldObject.{jmespath_expr} not found")
         return obj != old
 
     def snapshot(self) -> dict:

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from .errors import JMESPathError
+from .errors import JMESPathError, NotFoundError
 from .functions import FUNCTIONS, Expref
 from .parser import compile as compile_expr
 
@@ -26,6 +26,19 @@ def evaluate(node, value):
     return _HANDLERS[tag](node, value)
 
 
+def _soft(node, value):
+    """Evaluate treating the fork's missing-key NotFoundError as null.
+
+    The hard error is only wanted on the *spine* of a path expression (so
+    unresolved {{variables}} are detected); inside projections, filters,
+    logical operators, comparators, and function arguments a missing key
+    behaves like standard-JMESPath null."""
+    try:
+        return evaluate(node, value)
+    except NotFoundError:
+        return None
+
+
 def _identity(node, value):
     return value
 
@@ -39,8 +52,13 @@ def _literal(node, value):
 
 
 def _field(node, value):
+    # The reference pins the kyverno/go-jmespath fork (go.mod:64), which
+    # turns a missing map key into a NotFoundError instead of null — the
+    # variable system depends on this to detect unresolved variables.
     if isinstance(value, dict):
-        return value.get(node[1])
+        if node[1] not in value:
+            raise NotFoundError(f'Unknown key "{node[1]}" in path')
+        return value[node[1]]
     return None
 
 
@@ -80,7 +98,7 @@ def _projection(node, value):
         return None
     out = []
     for el in base:
-        r = evaluate(node[2], el)
+        r = _soft(node[2], el)
         if r is not None:
             out.append(r)
     return out
@@ -92,7 +110,7 @@ def _value_projection(node, value):
         return None
     out = []
     for el in base.values():
-        r = evaluate(node[2], el)
+        r = _soft(node[2], el)
         if r is not None:
             out.append(r)
     return out
@@ -111,7 +129,7 @@ def _flatten_projection(node, value):
     right = node[2] or ("identity",)
     out = []
     for el in merged:
-        r = evaluate(right, el)
+        r = _soft(right, el)
         if r is not None:
             out.append(r)
     return out
@@ -125,8 +143,8 @@ def _filter_projection(node, value):
     right = node[2] or ("identity",)
     out = []
     for el in base:
-        if not is_false(evaluate(cond, el)):
-            r = evaluate(right, el)
+        if not is_false(_soft(cond, el)):
+            r = _soft(right, el)
             if r is not None:
                 out.append(r)
     return out
@@ -134,8 +152,8 @@ def _filter_projection(node, value):
 
 def _comparator(node, value):
     op = node[1]
-    left = evaluate(node[2], value)
-    right = evaluate(node[3], value)
+    left = _soft(node[2], value)
+    right = _soft(node[3], value)
     if op == "==":
         return _deep_eq(left, right)
     if op == "!=":
@@ -168,21 +186,21 @@ def _deep_eq(a, b) -> bool:
 
 
 def _or(node, value):
-    left = evaluate(node[1], value)
+    left = _soft(node[1], value)
     if is_false(left):
-        return evaluate(node[2], value)
+        return _soft(node[2], value)
     return left
 
 
 def _and(node, value):
-    left = evaluate(node[1], value)
+    left = _soft(node[1], value)
     if is_false(left):
         return left
-    return evaluate(node[2], value)
+    return _soft(node[2], value)
 
 
 def _not(node, value):
-    return is_false(evaluate(node[1], value))
+    return is_false(_soft(node[1], value))
 
 
 def _pipe(node, value):
@@ -192,13 +210,13 @@ def _pipe(node, value):
 def _multiselect_list(node, value):
     if value is None:
         return None
-    return [evaluate(e, value) for e in node[1]]
+    return [_soft(e, value) for e in node[1]]
 
 
 def _multiselect_dict(node, value):
     if value is None:
         return None
-    return {k: evaluate(e, value) for k, e in node[1]}
+    return {k: _soft(e, value) for k, e in node[1]}
 
 
 def _function(node, value):
@@ -206,12 +224,12 @@ def _function(node, value):
     fn = FUNCTIONS.get(name)
     if fn is None:
         raise JMESPathError(f"unknown function: {name}()")
-    args = [evaluate(a, value) for a in node[2]]
+    args = [_soft(a, value) for a in node[2]]
     return fn(args)
 
 
 def _expref(node, value):
-    return Expref(node[1], evaluate)
+    return Expref(node[1], _soft)
 
 
 _HANDLERS = {

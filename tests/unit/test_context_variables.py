@@ -3,7 +3,13 @@ context_test.go scenarios)."""
 
 import pytest
 
-from kyverno_tpu.engine.context import Context, extract_image_info, merge_patch, parse_image
+from kyverno_tpu.engine.context import (
+    Context,
+    InvalidVariableError,
+    extract_image_info,
+    merge_patch,
+    parse_image,
+)
 from kyverno_tpu.engine.variables import (
     NotResolvedReferenceError,
     VariableResolutionError,
@@ -58,9 +64,11 @@ class TestContext:
         assert ctx.query("serviceAccountName") == "builder"
         assert ctx.query("serviceAccountNamespace") == "kube-system"
 
-    def test_missing_query_returns_none(self):
+    def test_missing_query_raises(self):
+        # fork semantics: unknown keys error (see interpreter._field)
         ctx = Context()
-        assert ctx.query("does.not.exist") is None
+        with pytest.raises(InvalidVariableError):
+            ctx.query("does.not.exist")
 
     def test_has_changed(self):
         ctx = Context()

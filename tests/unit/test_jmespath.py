@@ -11,7 +11,6 @@ class TestCore:
         [
             ("a", {"a": 1}, 1),
             ("a.b.c", {"a": {"b": {"c": 42}}}, 42),
-            ("a.b", {"a": {}}, None),
             ("a", [1], None),
             ("a[0]", {"a": [9]}, 9),
             ("a[-1]", {"a": [1, 2, 3]}, 3),
@@ -27,6 +26,13 @@ class TestCore:
     )
     def test_basics(self, expr, data, want):
         assert search(expr, data) == want
+
+    def test_missing_key_raises_not_found(self):
+        # kyverno/go-jmespath fork semantics (reference go.mod:64): a field
+        # access on a map without that key is an "Unknown key" error, which
+        # the variable system uses to detect unresolved variables.
+        with pytest.raises(JMESPathError):
+            search("a.b", {"a": {}})
 
     def test_projections(self):
         data = {"a": [{"b": {"c": 1}}, {"b": {"c": 2}}, {"x": 0}]}

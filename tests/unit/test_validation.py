@@ -1,0 +1,377 @@
+"""Validation driver tests, mirroring /root/reference/pkg/engine/validation_test.go
+(inline policy+resource JSON pairs asserted pass/fail/skip)."""
+
+import pytest
+
+from kyverno_tpu import store
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+from kyverno_tpu.engine.validation import validate
+
+
+def make_ctx(policy_doc, resource, old_resource=None):
+    jctx = Context()
+    jctx.add_resource(resource)
+    return PolicyContext(
+        policy=load_policy(policy_doc),
+        new_resource=resource,
+        old_resource=old_resource or {},
+        json_context=jctx,
+    )
+
+
+def pod(name="test-pod", image="nginx:latest", labels=None):
+    meta = {"name": name}
+    if labels:
+        meta["labels"] = labels
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {"containers": [{"name": "ctr", "image": image}]},
+    }
+
+
+def policy_with_rule(rule, name="test-policy"):
+    return {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"rules": [rule]},
+    }
+
+
+DISALLOW_LATEST = {
+    "name": "disallow-latest-tag",
+    "match": {"resources": {"kinds": ["Pod"]}},
+    "validate": {
+        "message": "Using a mutable image tag e.g. 'latest' is not allowed.",
+        "pattern": {
+            "spec": {"containers": [{"image": "!*:latest"}]}
+        },
+    },
+}
+
+
+class TestValidatePattern:
+    def test_fail_latest_tag(self):
+        resp = validate(make_ctx(policy_with_rule(DISALLOW_LATEST), pod()))
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+        assert "disallow-latest-tag" in resp.policy_response.rules[0].message
+
+    def test_pass_pinned_tag(self):
+        resp = validate(
+            make_ctx(policy_with_rule(DISALLOW_LATEST), pod(image="nginx:1.21"))
+        )
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+        assert resp.policy_response.rules_applied_count == 1
+
+    def test_non_matching_kind_produces_no_rule_response(self):
+        cm = {"apiVersion": "v1", "kind": "ConfigMap", "metadata": {"name": "x"}}
+        resp = validate(make_ctx(policy_with_rule(DISALLOW_LATEST), cm))
+        assert resp.policy_response.rules == []
+        assert resp.successful
+
+    def test_conditional_anchor_miss_skips(self):
+        rule = {
+            "name": "check-host-path",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "pattern": {
+                    "spec": {"volumes": [{"(hostPath)": {"path": "!/var/run/*"}}]}
+                }
+            },
+        }
+        resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        # no volumes at all -> pattern fails at spec.volumes -> FAIL
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+
+    def test_message_variable_substitution(self):
+        rule = {
+            "name": "name-in-msg",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "message": "resource {{request.object.metadata.name}} is bad",
+                "pattern": {"metadata": {"labels": {"app": "?*"}}},
+            },
+        }
+        resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.FAIL
+        assert "test-pod" in r.message
+
+
+class TestAnyPattern:
+    RULE = {
+        "name": "any-pattern",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {
+            "message": "only nginx or redis images",
+            "anyPattern": [
+                {"spec": {"containers": [{"image": "nginx:*"}]}},
+                {"spec": {"containers": [{"image": "redis:*"}]}},
+            ],
+        },
+    }
+
+    def test_pass_first(self):
+        resp = validate(make_ctx(policy_with_rule(self.RULE), pod(image="nginx:1.2")))
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+
+    def test_pass_second(self):
+        resp = validate(make_ctx(policy_with_rule(self.RULE), pod(image="redis:6")))
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.PASS
+        assert "anyPattern[1]" in r.message
+
+    def test_fail_none(self):
+        resp = validate(make_ctx(policy_with_rule(self.RULE), pod(image="mysql:8")))
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.FAIL
+        assert "only nginx or redis images" in r.message
+
+
+class TestDeny:
+    def test_deny_fails_when_conditions_met(self):
+        rule = {
+            "name": "block-team-label",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "message": "pods of team {{request.object.metadata.labels.team}} denied",
+                "deny": {
+                    "conditions": {
+                        "any": [
+                            {
+                                "key": "{{request.object.metadata.labels.team}}",
+                                "operator": "Equals",
+                                "value": "banned",
+                            }
+                        ]
+                    }
+                },
+            },
+        }
+        resp = validate(
+            make_ctx(policy_with_rule(rule), pod(labels={"team": "banned"}))
+        )
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.FAIL
+        assert "team banned denied" in r.message
+
+        resp = validate(make_ctx(policy_with_rule(rule), pod(labels={"team": "ok"})))
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+
+    def test_deny_bare_list_conditions(self):
+        rule = {
+            "name": "deny-list",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "deny": {
+                    "conditions": [
+                        {
+                            "key": "{{request.operation}}",
+                            "operator": "Equals",
+                            "value": "DELETE",
+                        }
+                    ]
+                }
+            },
+        }
+        ctx = make_ctx(policy_with_rule(rule), pod())
+        ctx.json_context.add_json({"request": {"operation": "DELETE"}})
+        resp = validate(ctx)
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+
+
+class TestPreconditions:
+    def test_preconditions_not_met_skips(self):
+        rule = dict(DISALLOW_LATEST)
+        rule["preconditions"] = {
+            "all": [
+                {
+                    "key": "{{request.operation}}",
+                    "operator": "Equals",
+                    "value": "CREATE",
+                }
+            ]
+        }
+        ctx = make_ctx(policy_with_rule(rule), pod())
+        ctx.json_context.add_json({"request": {"operation": "UPDATE"}})
+        resp = validate(ctx)
+        assert resp.policy_response.rules[0].status is RuleStatus.SKIP
+        assert resp.policy_response.rules_applied_count == 0
+
+    def test_unresolved_precondition_var_is_empty_string(self):
+        rule = dict(DISALLOW_LATEST)
+        rule["preconditions"] = {
+            "all": [
+                {"key": "{{request.no.such.path}}", "operator": "Equals", "value": ""}
+            ]
+        }
+        resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        # empty == empty -> preconditions pass -> pattern fails on :latest
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+
+
+class TestForEach:
+    RULE = {
+        "name": "check-images",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {
+            "message": "images must not use latest",
+            "foreach": [
+                {
+                    "list": "request.object.spec.containers",
+                    "pattern": {"image": "!*:latest"},
+                }
+            ],
+        },
+    }
+
+    def test_foreach_fail(self):
+        resp = validate(make_ctx(policy_with_rule(self.RULE), pod()))
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.FAIL
+        assert "foreach" in r.message
+
+    def test_foreach_pass(self):
+        resp = validate(make_ctx(policy_with_rule(self.RULE), pod(image="nginx:1")))
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+
+    def test_foreach_element_variable(self):
+        rule = {
+            "name": "element-var",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "foreach": [
+                    {
+                        "list": "request.object.spec.containers",
+                        "deny": {
+                            "conditions": {
+                                "any": [
+                                    {
+                                        "key": "{{element.image}}",
+                                        "operator": "Equals",
+                                        "value": "nginx:latest",
+                                    }
+                                ]
+                            }
+                        },
+                    }
+                ]
+            },
+        }
+        resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+
+
+class TestDeleteAndModify:
+    def test_delete_request_skips_validation(self):
+        ctx = make_ctx(policy_with_rule(DISALLOW_LATEST), {}, old_resource=pod())
+        ctx.new_resource = {}
+        resp = validate(ctx)
+        # rule matches old resource but DELETE produces no rule response
+        assert resp.policy_response.rules == []
+
+    def test_modify_same_verdict_skipped(self):
+        old = pod(image="nginx:latest")
+        new = pod(image="nginx:latest")
+        ctx = make_ctx(policy_with_rule(DISALLOW_LATEST), new, old_resource=old)
+        resp = validate(ctx)
+        assert resp.policy_response.rules == []
+
+    def test_modify_verdict_change_reported(self):
+        old = pod(image="nginx:1.0")
+        new = pod(image="nginx:latest")
+        ctx = make_ctx(policy_with_rule(DISALLOW_LATEST), new, old_resource=old)
+        resp = validate(ctx)
+        assert resp.policy_response.rules[0].status is RuleStatus.FAIL
+
+
+class TestMockContext:
+    def test_context_entry_from_mock_store(self):
+        rule = {
+            "name": "allowed-registries",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "context": [{"name": "registries", "configMap": {"name": "regs", "namespace": "default"}}],
+            "validate": {
+                "deny": {
+                    "conditions": {
+                        "all": [
+                            {
+                                "key": "{{registries.allowed}}",
+                                "operator": "NotEquals",
+                                "value": "docker.io",
+                            }
+                        ]
+                    }
+                }
+            },
+        }
+        store.set_mock(True)
+        store.set_context(
+            store.Context(
+                policies=[
+                    store.Policy(
+                        name="test-policy",
+                        rules=[
+                            store.Rule(
+                                name="allowed-registries",
+                                values={"registries.allowed": "docker.io"},
+                            )
+                        ],
+                    )
+                ]
+            )
+        )
+        try:
+            resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        finally:
+            store.set_mock(False)
+            store.set_context(store.Context())
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+
+    def test_missing_mock_values_is_error(self):
+        rule = {
+            "name": "needs-context",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "context": [{"name": "cm", "configMap": {"name": "x", "namespace": "y"}}],
+            "validate": {"pattern": {"metadata": {"name": "?*"}}},
+        }
+        store.set_mock(True)
+        try:
+            resp = validate(make_ctx(policy_with_rule(rule), pod()))
+        finally:
+            store.set_mock(False)
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.ERROR
+        assert resp.policy_response.rules_error_count == 1
+
+
+class TestRuleChaining:
+    def test_multiple_rules_all_reported(self):
+        policy = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "multi"},
+            "spec": {
+                "rules": [
+                    DISALLOW_LATEST,
+                    {
+                        "name": "require-app-label",
+                        "match": {"resources": {"kinds": ["Pod"]}},
+                        "validate": {
+                            "message": "label app required",
+                            "pattern": {"metadata": {"labels": {"app": "?*"}}},
+                        },
+                    },
+                ]
+            },
+        }
+        resp = validate(make_ctx(policy, pod()))
+        statuses = [r.status for r in resp.policy_response.rules]
+        assert statuses == [RuleStatus.FAIL, RuleStatus.FAIL]
+        assert not resp.successful
+        assert resp.get_failed_rules() == ["disallow-latest-tag", "require-app-label"]
