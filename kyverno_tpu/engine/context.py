@@ -121,9 +121,15 @@ class Context:
             raise InvalidVariableError(f"incorrect query {query!r}: {e}") from e
 
     def has_changed(self, jmespath_expr: str) -> bool:
-        """context/evaluate.go:52. Missing paths raise from query()."""
+        """context/evaluate.go:52. Missing keys raise from query(); a path
+        resolving to null (e.g. through a null parent) raises here, as the
+        reference treats nil results as 'not found'."""
         obj = self.query(f"request.object.{jmespath_expr}")
+        if obj is None:
+            raise InvalidVariableError(f"request.object.{jmespath_expr} not found")
         old = self.query(f"request.oldObject.{jmespath_expr}")
+        if old is None:
+            raise InvalidVariableError(f"request.oldObject.{jmespath_expr} not found")
         return obj != old
 
     def snapshot(self) -> dict:

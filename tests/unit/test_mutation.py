@@ -1,0 +1,361 @@
+"""Mutation tier tests, mirroring /root/reference/pkg/engine/mutation_test.go
+and mutate/*_test.go semantics."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.force_mutate import force_mutate
+from kyverno_tpu.engine.mutate.json_patch import (
+    apply_patch_ops,
+    create_patch,
+    filter_and_sort_patches,
+    generate_patches,
+)
+from kyverno_tpu.engine.mutate.strategic_merge import (
+    merge,
+    pre_process_pattern,
+    strategic_merge_patch,
+)
+from kyverno_tpu.engine.mutation import mutate
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+
+
+def make_ctx(policy_doc, resource):
+    jctx = Context()
+    jctx.add_resource(resource)
+    return PolicyContext(
+        policy=load_policy(policy_doc),
+        new_resource=resource,
+        json_context=jctx,
+    )
+
+
+def policy_with_rule(rule, name="test-policy"):
+    return {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"rules": [rule]},
+    }
+
+
+def pod(name="test-pod", labels=None):
+    meta = {"name": name}
+    if labels is not None:
+        meta["labels"] = labels
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {"containers": [{"name": "ctr", "image": "nginx:1.21"}]},
+    }
+
+
+class TestJsonPatch:
+    def test_apply_basic_ops(self):
+        doc = {"a": 1, "b": [1, 2]}
+        out = apply_patch_ops(
+            doc,
+            [
+                {"op": "replace", "path": "/a", "value": 9},
+                {"op": "add", "path": "/b/-", "value": 3},
+                {"op": "remove", "path": "/b/0"},
+                {"op": "add", "path": "/c/d", "value": "x"},  # ensure-path
+            ],
+        )
+        assert out == {"a": 9, "b": [2, 3], "c": {"d": "x"}}
+        assert doc == {"a": 1, "b": [1, 2]}  # input untouched
+
+    def test_negative_index_and_missing_remove(self):
+        doc = {"b": [1, 2, 3]}
+        out = apply_patch_ops(
+            doc,
+            [
+                {"op": "replace", "path": "/b/-1", "value": 99},
+                {"op": "remove", "path": "/nope"},  # AllowMissingPathOnRemove
+            ],
+        )
+        assert out == {"b": [1, 2, 99]}
+
+    def test_create_patch_roundtrip(self):
+        src = {"a": 1, "b": {"c": [1, 2, 3]}, "d": "keep"}
+        dst = {"a": 2, "b": {"c": [1, 9]}, "e": True}
+        ops = create_patch(src, dst)
+        assert apply_patch_ops(src, ops) == dst
+
+    def test_generate_patches_filters_status_and_metadata(self):
+        src = {"metadata": {"resourceVersion": "1"}, "status": {"x": 1}, "spec": {}}
+        dst = {
+            "metadata": {"resourceVersion": "2", "labels": {"a": "b"}},
+            "status": {"x": 2},
+            "spec": {"replicas": 1},
+        }
+        patches = generate_patches(src, dst)
+        paths = [p["path"] for p in patches]
+        assert "/spec/replicas" in paths
+        assert "/metadata/labels" in paths
+        assert not any("/status" in p for p in paths)
+        assert not any("resourceVersion" in p for p in paths)
+
+    def test_removal_reordering(self):
+        patches = [
+            {"op": "remove", "path": "/a/0"},
+            {"op": "remove", "path": "/a/1"},
+            {"op": "remove", "path": "/a/2"},
+        ]
+        out = filter_and_sort_patches(patches)
+        assert [p["path"] for p in out] == ["/a/2", "/a/1", "/a/0"]
+
+
+class TestStrategicMerge:
+    def test_map_merge_and_null_delete(self):
+        base = {"a": {"x": 1, "y": 2}, "keep": True}
+        patch = {"a": {"x": 9, "y": None, "z": 3}}
+        assert merge(patch, base) == {"a": {"x": 9, "z": 3}, "keep": True}
+
+    def test_list_merge_by_name(self):
+        base = {"containers": [{"name": "a", "image": "old"}, {"name": "b"}]}
+        patch = {"containers": [{"name": "a", "image": "new"}, {"name": "c"}]}
+        out = merge(patch, base)
+        assert out["containers"] == [
+            {"name": "a", "image": "new"},
+            {"name": "b"},
+            {"name": "c"},
+        ]
+
+    def test_scalar_list_replaces(self):
+        assert merge({"args": ["x"]}, {"args": ["a", "b"]}) == {"args": ["x"]}
+
+    def test_add_anchor(self):
+        # +(key): added only when missing (handleAddings)
+        resource = {"metadata": {"labels": {"existing": "1"}}}
+        pattern = {"metadata": {"labels": {"+(existing)": "nope", "+(new)": "added"}}}
+        out = strategic_merge_patch(resource, pattern)
+        assert out["metadata"]["labels"] == {"existing": "1", "new": "added"}
+
+    def test_condition_anchor_gates_patch(self):
+        pattern = {"spec": {"(hostNetwork)": True, "priority": 100}}
+        with_host = {"spec": {"hostNetwork": True}}
+        without = {"spec": {"hostNetwork": False}}
+        assert strategic_merge_patch(with_host, pattern)["spec"]["priority"] == 100
+        assert "priority" not in strategic_merge_patch(without, pattern)["spec"]
+
+    def test_condition_anchor_missing_key_skips(self):
+        pattern = {"spec": {"(hostNetwork)": True, "priority": 100}}
+        res = {"spec": {}}
+        assert strategic_merge_patch(res, pattern) == res
+
+    def test_anchored_list_element_expands_by_name(self):
+        # set imagePullPolicy on containers whose image is :latest
+        pattern = {
+            "spec": {
+                "containers": [
+                    {"(image)": "*:latest", "imagePullPolicy": "Always"}
+                ]
+            }
+        }
+        resource = {
+            "spec": {
+                "containers": [
+                    {"name": "a", "image": "nginx:latest"},
+                    {"name": "b", "image": "redis:6"},
+                ]
+            }
+        }
+        out = strategic_merge_patch(resource, pattern)
+        by_name = {c["name"]: c for c in out["spec"]["containers"]}
+        assert by_name["a"]["imagePullPolicy"] == "Always"
+        assert "imagePullPolicy" not in by_name["b"]
+
+    def test_preprocess_strips_anchor_only_patterns(self):
+        pattern = {"spec": {"(hostNetwork)": False}}
+        resource = {"spec": {"hostNetwork": False}}
+        out = pre_process_pattern(pattern, resource)
+        assert out == {}
+
+
+class TestMutateDriver:
+    ADD_LABEL = {
+        "name": "add-label",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "mutate": {
+            "patchStrategicMerge": {
+                "metadata": {"labels": {"+(app)": "default-app"}}
+            }
+        },
+    }
+
+    def test_adds_missing_label(self):
+        ctx = make_ctx(policy_with_rule(self.ADD_LABEL), pod(labels={}))
+        resp = mutate(ctx)
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.PASS
+        assert resp.patched_resource["metadata"]["labels"]["app"] == "default-app"
+        assert any(p["path"].endswith("labels") or "app" in p["path"] for p in r.patches)
+
+    def test_existing_label_untouched_reports_skip(self):
+        ctx = make_ctx(
+            policy_with_rule(self.ADD_LABEL), pod(labels={"app": "mine"})
+        )
+        resp = mutate(ctx)
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.SKIP
+        assert resp.patched_resource["metadata"]["labels"]["app"] == "mine"
+
+    def test_json6902_patch(self):
+        rule = {
+            "name": "6902",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "mutate": {
+                "patchesJson6902": (
+                    "- op: add\n"
+                    "  path: /metadata/labels/env\n"
+                    "  value: prod\n"
+                )
+            },
+        }
+        ctx = make_ctx(policy_with_rule(rule), pod(labels={}))
+        resp = mutate(ctx)
+        assert resp.policy_response.rules[0].status is RuleStatus.PASS
+        assert resp.patched_resource["metadata"]["labels"]["env"] == "prod"
+
+    def test_rule_chaining(self):
+        policy = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "chain"},
+            "spec": {
+                "rules": [
+                    {
+                        "name": "first",
+                        "match": {"resources": {"kinds": ["Pod"]}},
+                        "mutate": {
+                            "patchStrategicMerge": {
+                                "metadata": {"labels": {"+(stage)": "one"}}
+                            }
+                        },
+                    },
+                    {
+                        "name": "second",
+                        "match": {"resources": {"kinds": ["Pod"]}},
+                        "mutate": {
+                            "patchStrategicMerge": {
+                                "metadata": {
+                                    "labels": {
+                                        "copied": "{{request.object.metadata.labels.stage}}"
+                                    }
+                                }
+                            }
+                        },
+                    },
+                ]
+            },
+        }
+        ctx = make_ctx(policy, pod(labels={}))
+        resp = mutate(ctx)
+        assert [r.status for r in resp.policy_response.rules] == [
+            RuleStatus.PASS,
+            RuleStatus.PASS,
+        ]
+        labels = resp.patched_resource["metadata"]["labels"]
+        assert labels["stage"] == "one"
+        assert labels["copied"] == "one"  # second rule saw first rule's patch
+
+    def test_variable_substitution_in_patch(self):
+        rule = {
+            "name": "var-label",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "mutate": {
+                "patchStrategicMerge": {
+                    "metadata": {
+                        "labels": {"appname": "{{request.object.metadata.name}}"}
+                    }
+                }
+            },
+        }
+        ctx = make_ctx(policy_with_rule(rule), pod(name="my-pod", labels={}))
+        resp = mutate(ctx)
+        assert resp.patched_resource["metadata"]["labels"]["appname"] == "my-pod"
+
+    def test_preconditions_mismatch_skips(self):
+        rule = dict(self.ADD_LABEL)
+        rule["preconditions"] = {
+            "all": [
+                {"key": "{{request.operation}}", "operator": "Equals", "value": "CREATE"}
+            ]
+        }
+        ctx = make_ctx(policy_with_rule(rule), pod(labels={}))
+        ctx.json_context.add_json({"request": {"operation": "UPDATE"}})
+        resp = mutate(ctx)
+        assert resp.policy_response.rules[0].status is RuleStatus.SKIP
+
+    def test_foreach_mutation(self):
+        rule = {
+            "name": "foreach-pull-policy",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "mutate": {
+                "foreach": [
+                    {
+                        "list": "request.object.spec.containers",
+                        "patchStrategicMerge": {
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "(name)": "{{element.name}}",
+                                        "imagePullPolicy": "IfNotPresent",
+                                    }
+                                ]
+                            }
+                        },
+                    }
+                ]
+            },
+        }
+        resource = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {"name": "a", "image": "x:1"},
+                    {"name": "b", "image": "y:2"},
+                ]
+            },
+        }
+        ctx = make_ctx(policy_with_rule(rule), resource)
+        resp = mutate(ctx)
+        r = resp.policy_response.rules[0]
+        assert r.status is RuleStatus.PASS
+        for c in resp.patched_resource["spec"]["containers"]:
+            assert c["imagePullPolicy"] == "IfNotPresent"
+
+
+class TestForceMutate:
+    def test_force_mutate_ignores_preconditions(self):
+        rule = {
+            "name": "add-label",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "preconditions": {
+                "all": [{"key": "x", "operator": "Equals", "value": "never"}]
+            },
+            "mutate": {
+                "patchStrategicMerge": {"metadata": {"labels": {"forced": "yes"}}}
+            },
+        }
+        policy = load_policy(policy_with_rule(rule))
+        out = force_mutate(None, policy, pod(labels={}))
+        assert out["metadata"]["labels"]["forced"] == "yes"
+
+    def test_force_mutate_placeholder_for_unresolved_vars(self):
+        rule = {
+            "name": "add-var-label",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "mutate": {
+                "patchStrategicMerge": {
+                    "metadata": {"labels": {"who": "{{request.userInfo.username}}"}}
+                }
+            },
+        }
+        policy = load_policy(policy_with_rule(rule))
+        out = force_mutate(None, policy, pod(labels={}))
+        assert out["metadata"]["labels"]["who"] == "placeholderValue"
