@@ -1,0 +1,113 @@
+"""Benchmark: policy x resource validations/sec on one chip.
+
+Replays BASELINE.md config [2]: the best_practices validate corpus
+(~13 policies / 17 rules) against a synthetic Pod batch, steady-state
+device throughput (the background-scan replay regime — flatten once,
+evaluate repeatedly, as the scanner does per interval over a snapshot).
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline is measured / 100k — the north-star target from BASELINE.json
+(the reference publishes no numbers; see BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_pod(i: int) -> dict:
+    imgs = ["nginx:latest", "nginx:1.21", "redis:6", "registry.io/a/b:v2"]
+    c = {
+        "name": f"c{i % 3}",
+        "image": imgs[i % 4],
+    }
+    if i % 3:
+        c["resources"] = {
+            "requests": {"memory": "64Mi", "cpu": "100m"},
+            "limits": {"memory": "128Mi"},
+        }
+    if i % 5 == 0:
+        c["securityContext"] = {"privileged": i % 2 == 0}
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"pod-{i}"},
+        "spec": {"containers": [c]},
+    }
+    if i % 4 == 0:
+        pod["metadata"]["labels"] = {
+            "app.kubernetes.io/name": "bench",
+            "app.kubernetes.io/component": "api",
+        }
+    if i % 7 == 0:
+        pod["spec"]["volumes"] = [{"name": "v", "emptyDir": {}}]
+    return pod
+
+
+def main() -> None:
+    from kyverno_tpu.api.load import load_policies_from_path
+    from kyverno_tpu.models import CompiledPolicySet
+
+    policies = load_policies_from_path("/root/reference/test/best_practices/")
+    cps = CompiledPolicySet(policies)
+
+    batch_size = 4096
+    resources = [make_pod(i) for i in range(batch_size)]
+
+    t0 = time.monotonic()
+    batch = cps.flatten(resources)
+    flatten_s = time.monotonic() - t0
+
+    args = (
+        batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
+        batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
+        batch.elem0, batch.kind_id, batch.host_flag, batch.str_bytes,
+        batch.str_len,
+    )
+
+    fn = cps.eval_fn
+    out = fn(*args)
+    out.block_until_ready()  # compile + first run
+
+    # steady state
+    n_iters = 10
+    t0 = time.monotonic()
+    for _ in range(n_iters):
+        out = fn(*args)
+    out.block_until_ready()
+    device_s = (time.monotonic() - t0) / n_iters
+
+    n_rules = int(cps.tensors.n_rules)
+    n_device_rules = int((~cps.tensors.rule_host_only).sum())
+    validations = batch_size * n_rules
+    device_rate = validations / device_s
+    # end-to-end rate for a fresh snapshot (flatten amortized once per scan)
+    e2e_rate = validations / (device_s + flatten_s / 1)
+
+    verdicts = np.array(out)
+    result = {
+        "metric": "policy-rule x resource validations/sec (device, steady state)",
+        "value": round(device_rate),
+        "unit": "validations/sec",
+        "vs_baseline": round(device_rate / 100_000, 3),
+        "detail": {
+            "batch": batch_size,
+            "rules": n_rules,
+            "device_rules": n_device_rules,
+            "device_s_per_batch": round(device_s, 5),
+            "flatten_s": round(flatten_s, 3),
+            "e2e_rate_with_flatten": round(e2e_rate),
+            "verdict_histogram": {
+                str(k): int(v)
+                for k, v in zip(*np.unique(verdicts, return_counts=True))
+            },
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

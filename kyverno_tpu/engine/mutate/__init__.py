@@ -5,7 +5,7 @@ is rewritten to patchStrategicMerge exactly as the reference does
 (mutate/mutation.go:25-30).
 """
 
-from .json_patch import apply_patch, apply_patch_ops, create_patch, generate_patches
+from .json_patch import apply_patch_ops, create_patch, generate_patches
 from .strategic_merge import (
     ConditionError,
     GlobalConditionError,
@@ -13,7 +13,6 @@ from .strategic_merge import (
 )
 
 __all__ = [
-    "apply_patch",
     "apply_patch_ops",
     "create_patch",
     "generate_patches",

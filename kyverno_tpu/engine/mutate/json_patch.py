@@ -47,15 +47,19 @@ def _resolve_parent(doc, tokens: list[str], ensure: bool = False):
     missing intermediate objects are created (EnsurePathExistsOnAdd)."""
     node = doc
     for i, token in enumerate(tokens[:-1]):
+        nxt = tokens[i + 1]
         if isinstance(node, dict):
             if token not in node:
                 if not ensure:
                     raise JsonPatchError(f"path not found: /{'/'.join(tokens[:i + 1])}")
-                nxt = tokens[i + 1]
                 node[token] = [] if nxt == "-" or _INT_RE.match(nxt) else {}
             node = node[token]
         elif isinstance(node, list):
-            idx = _array_index(token, len(node), for_add=False)
+            idx = _array_index(token, len(node), for_add=ensure)
+            if idx == len(node):
+                # EnsurePathExistsOnAdd appends a fresh container so the
+                # remaining tokens have somewhere to land
+                node.append([] if nxt == "-" or _INT_RE.match(nxt) else {})
             node = node[idx]
         else:
             raise JsonPatchError(f"cannot traverse scalar at /{'/'.join(tokens[:i + 1])}")
@@ -101,16 +105,17 @@ def get_by_pointer(doc, pointer: str):
 
 def apply_patch_ops(doc, ops: list[dict]):
     """Apply an RFC6902 op list to a deep copy of ``doc``; returns the new
-    document. Options match the reference (patchJson6902.go:76)."""
+    document. Options match the reference (patchJson6902.go:76). Malformed
+    ops surface as JsonPatchError (a failed rule), never as a crash."""
     result = copy.deepcopy(doc)
     for op in ops:
-        result = _apply_one(result, op)
+        try:
+            result = _apply_one(result, op)
+        except JsonPatchError:
+            raise
+        except (AttributeError, IndexError, KeyError, TypeError) as e:
+            raise JsonPatchError(f"malformed patch op {op!r}: {e}") from e
     return result
-
-
-def apply_patch(doc, op: dict):
-    """Apply a single op (utils.ApplyPatches path for raw ``patches:``)."""
-    return apply_patch_ops(doc, [op])
 
 
 def _apply_one(doc, op: dict):
@@ -199,8 +204,20 @@ def create_patch(src, dst) -> list[dict]:
     return ops
 
 
+def _strict_eq(a, b) -> bool:
+    """Deep equality that — unlike Python's == — distinguishes bool from
+    int/float (JSON true != 1) at any depth."""
+    if isinstance(a, bool) or isinstance(b, bool):
+        return isinstance(a, bool) and isinstance(b, bool) and a == b
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(_strict_eq(v, b[k]) for k, v in a.items())
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(_strict_eq(x, y) for x, y in zip(a, b))
+    return type(a) is type(b) and a == b
+
+
 def _diff(src, dst, path: str, ops: list[dict]) -> None:
-    if type(src) is type(dst) and src == dst:
+    if _strict_eq(src, dst):
         return
     if isinstance(src, dict) and isinstance(dst, dict):
         for key in src:

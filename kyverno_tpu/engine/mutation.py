@@ -176,7 +176,9 @@ def _mutate_foreach(rule, policy_ctx: PolicyContext, resource: dict):
                 outcome, err = _mutate_resource(
                     rule, element_ctx, patched_resource, foreach_index
                 )
-                if err is not None and not outcome.skip:
+                if err is not None:
+                    if outcome.skip:
+                        continue  # element not matched / preconditions miss
                     return (
                         rule_response(rule, RuleType.MUTATION, str(err), RuleStatus.ERROR),
                         resource,

@@ -1,0 +1,11 @@
+"""Policy IR + compiler: policy YAML -> flat pattern tensors.
+
+The recursive pattern matcher (/root/reference/pkg/engine/validate/validate.go:29)
+becomes data: every (rule, pattern-leaf) compiles to a check row over a shared
+path dictionary, resources flatten to (path, value) rows, and evaluation is a
+batched join + leaf-comparator NFA on device (kyverno_tpu.ops).
+"""
+
+from .engine import CompiledPolicySet, Verdict, compile_policies
+
+__all__ = ["CompiledPolicySet", "Verdict", "compile_policies"]

@@ -1,0 +1,288 @@
+"""RuleIR -> pattern tensors.
+
+Produces the static, device-resident representation of a policy set:
+
+- a path dictionary (generalized paths; array segments are ``*``)
+- flat check arrays (one row per leaf check)
+- glob-NFA tables for string operands (consumed by ops/glob.py)
+- rule/alt/group segment maps for the verdict reduction (ops/eval.py)
+- per-rule kind sets for the match prefilter
+
+This is the ``policycache emits a precompiled policy tensor`` component of
+the north star (BASELINE.json) — the TPU analogue of
+/root/reference/pkg/policycache building its kind index at policy admission.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .ir import SEP, CheckAnchor, CheckOp, RuleIR
+
+# Glob NFA geometry: patterns longer than NFA_STATES-1 chars or values
+# longer than STR_LEN bytes take the host lane.
+NFA_STATES = 48
+STR_LEN = 64
+MAX_SEGMENTS = 12
+
+
+@dataclass
+class PolicyTensors:
+    # path dictionary
+    paths: list[str]                      # SEP-joined generalized paths
+    path_index: dict[str, int]
+    path_wildcards: np.ndarray            # [P] number of '*' segments
+
+    # checks (C rows)
+    chk_path: np.ndarray                  # [C] int32 path id
+    chk_op: np.ndarray                    # [C] int8 CheckOp
+    chk_rule: np.ndarray                  # [C] int32 rule row
+    chk_alt_gid: np.ndarray               # [C] int32 global alt id
+    chk_group_gid: np.ndarray             # [C] int32 global group id
+    chk_gate: np.ndarray                  # [C] int32 global gate id (-1 none)
+    chk_guard: np.ndarray                 # [C] uint16 guard depth bitmask
+    chk_is_gate_row: np.ndarray           # [C] bool (ELEMENT_GATE rows)
+    chk_is_cond: np.ndarray               # [C] bool (CONDITION/GLOBAL rows)
+    chk_tracked: np.ndarray               # [C] bool (anchorMap-tracked rows)
+    chk_existence: np.ndarray             # [C] bool OR-over-elements
+    chk_nfa: np.ndarray                   # [C] int32 NFA id (-1 none)
+    chk_num_lo: np.ndarray                # [C] int64 micro-units
+    chk_num_hi: np.ndarray                # [C] int64
+    chk_bool: np.ndarray                  # [C] bool
+    chk_num_fallback: np.ndarray          # [C] bool
+    chk_track_depth: np.ndarray           # [C] int8 anchorMap key depth (-1)
+    chk_cond_depth: np.ndarray            # [C] int8 condition key depth (-1)
+
+    # group -> alt -> rule segment maps
+    n_groups: int
+    n_alts: int
+    group_alt: np.ndarray                 # [G] int32 alt id of each group
+    alt_rule: np.ndarray                  # [A] int32 rule row of each alt
+    n_gates: int
+
+    # NFA tables [N, S]
+    nfa_char: np.ndarray                  # uint8 literal char (0 if meta)
+    nfa_is_star: np.ndarray               # bool
+    nfa_is_q: np.ndarray                  # bool
+    nfa_len: np.ndarray                   # [N] int32 pattern length
+
+    # rules (R rows, includes host-only rules for verdict indexing)
+    n_rules: int
+    rule_kind_ids: np.ndarray             # [R, KMAX] int32, -1 padding
+    rule_match_all_kinds: np.ndarray      # [R] bool ('*' kind)
+    rule_host_only: np.ndarray            # [R] bool
+    kind_index: dict[str, int]
+    rules: list[RuleIR] = field(default_factory=list)
+
+    @property
+    def n_paths(self) -> int:
+        return len(self.paths)
+
+
+def _compile_glob(pattern: str):
+    """Glob pattern -> NFA row (char / is_star / is_q per state). Runs of
+    '*' collapse to one so the NFA epsilon-closure is a single shift."""
+    while "**" in pattern:
+        pattern = pattern.replace("**", "*")
+    if len(pattern) > NFA_STATES - 1:
+        return None
+    char = np.zeros(NFA_STATES, dtype=np.uint8)
+    star = np.zeros(NFA_STATES, dtype=bool)
+    q = np.zeros(NFA_STATES, dtype=bool)
+    for i, ch in enumerate(pattern):
+        b = ch.encode("utf-8")
+        if len(b) != 1:
+            return None  # non-ASCII pattern: host lane
+        if ch == "*":
+            star[i] = True
+        elif ch == "?":
+            q[i] = True
+        else:
+            char[i] = b[0]
+    return char, star, q, len(pattern)
+
+
+def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
+    paths: list[str] = []
+    path_index: dict[str, int] = {}
+
+    def path_id(p: str) -> int:
+        if p not in path_index:
+            path_index[p] = len(paths)
+            paths.append(p)
+        return path_index[p]
+
+    nfa_rows = []
+    nfa_index: dict[str, int] = {}
+
+    def nfa_id(pattern: str, rule: RuleIR) -> int:
+        if pattern in nfa_index:
+            return nfa_index[pattern]
+        row = _compile_glob(pattern)
+        if row is None:
+            rule.host_only = True
+            rule.host_reason = f"glob pattern not NFA-compilable: {pattern!r}"
+            return -1
+        nfa_index[pattern] = len(nfa_rows)
+        nfa_rows.append(row)
+        return nfa_index[pattern]
+
+    # validate device-lane constraints that depend on tensor geometry
+    for rule in rule_irs:
+        if rule.host_only:
+            continue
+        for c in rule.checks:
+            if len(c.path.split(SEP)) > MAX_SEGMENTS:
+                rule.host_only = True
+                rule.host_reason = "path too deep"
+                break
+
+    cols: dict[str, list] = {k: [] for k in (
+        "path", "op", "rule", "alt", "group", "gate", "guard", "is_gate",
+        "is_cond", "tracked", "exist", "nfa", "lo", "hi", "bool", "numfb",
+        "track_depth", "cond_depth",
+    )}
+    group_alt: list[int] = []
+    alt_rule: list[int] = []
+    n_gates_total = 0
+
+    kind_index: dict[str, int] = {}
+
+    def kind_id(k: str) -> int:
+        if k not in kind_index:
+            kind_index[k] = len(kind_index)
+        return kind_index[k]
+
+    for rule in rule_irs:
+        if rule.host_only:
+            continue
+        alt_base = len(alt_rule)
+        for _ in range(rule.n_alts):
+            alt_rule.append(rule.rule_index)
+        # renumber (alt, group) pairs globally
+        local_groups: dict[tuple[int, int], int] = {}
+        gate_base = n_gates_total
+        n_gates_total += rule.n_gates
+
+        for c in rule.checks:
+            key = (c.alt, c.group)
+            if key not in local_groups:
+                local_groups[key] = len(group_alt)
+                group_alt.append(alt_base + c.alt)
+            gid = local_groups[key]
+
+            n = -1
+            if c.op in (CheckOp.STR_EQ, CheckOp.STR_NE):
+                n = nfa_id(c.pattern_str, rule)
+                if rule.host_only:
+                    break
+
+            is_gate = c.anchor is CheckAnchor.ELEMENT_GATE
+            is_cond = c.anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL)
+            tracked = is_cond or is_gate or c.op is CheckOp.ABSENT or c.existence
+            segments = c.path.split(SEP)
+            if is_cond:
+                track_depth = c.cond_depth
+            elif c.existence:
+                track_depth = segments.index("*") if "*" in segments else len(segments)
+            elif is_gate or c.op is CheckOp.ABSENT:
+                track_depth = len(segments)
+            else:
+                track_depth = -1
+
+            cols["path"].append(path_id(c.path))
+            cols["op"].append(int(c.op))
+            cols["rule"].append(rule.rule_index)
+            cols["alt"].append(alt_base + c.alt)
+            cols["group"].append(gid)
+            cols["gate"].append(gate_base + c.gate if c.gate >= 0 else -1)
+            cols["guard"].append(c.guard_mask)
+            cols["is_gate"].append(is_gate)
+            cols["is_cond"].append(is_cond)
+            cols["tracked"].append(tracked)
+            cols["exist"].append(c.existence)
+            cols["nfa"].append(n)
+            cols["lo"].append(c.num_lo)
+            cols["hi"].append(c.num_hi)
+            cols["bool"].append(c.bool_val)
+            cols["numfb"].append(c.num_fallback)
+            cols["track_depth"].append(track_depth)
+            cols["cond_depth"].append(c.cond_depth)
+
+        if rule.host_only:
+            # roll back this rule's rows
+            n_rows = len([1 for r in cols["rule"] if r == rule.rule_index])
+            for k in cols:
+                cols[k] = cols[k][: len(cols[k]) - n_rows]
+            del alt_rule[alt_base:]
+            del group_alt[len(group_alt) - len(local_groups):]
+            n_gates_total = gate_base
+
+    n_rules = max((r.rule_index for r in rule_irs), default=-1) + 1
+    kmax = max((len(r.kinds) for r in rule_irs), default=1) or 1
+    rule_kinds = np.full((n_rules, kmax), -1, dtype=np.int32)
+    rule_all_kinds = np.zeros(n_rules, dtype=bool)
+    rule_host = np.zeros(n_rules, dtype=bool)
+    for rule in rule_irs:
+        rule_host[rule.rule_index] = rule.host_only
+        for j, k in enumerate(rule.kinds):
+            if k == "*":
+                rule_all_kinds[rule.rule_index] = True
+            else:
+                # "Pod" matches "Pod" and "v1/Pod" style GVKs; store bare kind
+                rule_kinds[rule.rule_index, j] = kind_id(k.split("/")[-1])
+
+    if nfa_rows:
+        nfa_char = np.stack([r[0] for r in nfa_rows])
+        nfa_star = np.stack([r[1] for r in nfa_rows])
+        nfa_q = np.stack([r[2] for r in nfa_rows])
+        nfa_len = np.array([r[3] for r in nfa_rows], dtype=np.int32)
+    else:
+        nfa_char = np.zeros((1, NFA_STATES), dtype=np.uint8)
+        nfa_star = np.zeros((1, NFA_STATES), dtype=bool)
+        nfa_q = np.zeros((1, NFA_STATES), dtype=bool)
+        nfa_len = np.zeros(1, dtype=np.int32)
+
+    def arr(k, dtype):
+        return np.array(cols[k], dtype=dtype)
+
+    return PolicyTensors(
+        paths=paths,
+        path_index=path_index,
+        path_wildcards=np.array([p.split(SEP).count("*") for p in paths], dtype=np.int32),
+        chk_path=arr("path", np.int32),
+        chk_op=arr("op", np.int8),
+        chk_rule=arr("rule", np.int32),
+        chk_alt_gid=arr("alt", np.int32),
+        chk_group_gid=arr("group", np.int32),
+        chk_gate=arr("gate", np.int32),
+        chk_guard=arr("guard", np.uint16),
+        chk_is_gate_row=arr("is_gate", bool),
+        chk_is_cond=arr("is_cond", bool),
+        chk_tracked=arr("tracked", bool),
+        chk_existence=arr("exist", bool),
+        chk_nfa=arr("nfa", np.int32),
+        chk_num_lo=arr("lo", np.int64),
+        chk_num_hi=arr("hi", np.int64),
+        chk_bool=arr("bool", bool),
+        chk_num_fallback=arr("numfb", bool),
+        chk_track_depth=arr("track_depth", np.int8),
+        chk_cond_depth=arr("cond_depth", np.int8),
+        n_groups=len(group_alt),
+        n_alts=len(alt_rule),
+        group_alt=np.array(group_alt, dtype=np.int32) if group_alt else np.zeros(0, np.int32),
+        alt_rule=np.array(alt_rule, dtype=np.int32) if alt_rule else np.zeros(0, np.int32),
+        n_gates=n_gates_total,
+        nfa_char=nfa_char,
+        nfa_is_star=nfa_star,
+        nfa_is_q=nfa_q,
+        nfa_len=nfa_len,
+        n_rules=n_rules,
+        rule_kind_ids=rule_kinds,
+        rule_match_all_kinds=rule_all_kinds,
+        rule_host_only=rule_host,
+        kind_index=kind_index,
+        rules=rule_irs,
+    )

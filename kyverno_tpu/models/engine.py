@@ -1,0 +1,132 @@
+"""CompiledPolicySet: the two-tier engine facade.
+
+``compile_policies`` freezes a policy set into pattern tensors (the TPU
+analogue of /root/reference/pkg/policycache); ``evaluate`` scores a resource
+batch on device and routes host-lane rules/resources through the CPU oracle
+(engine/validation.py), so every verdict is reference-faithful.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import IntEnum
+
+import numpy as np
+
+from ..engine.context import Context
+from ..engine.policy_context import PolicyContext
+from ..engine.response import RuleStatus
+from ..engine.validation import validate as oracle_validate
+from .compiler import PolicyTensors, compile_tensors
+from .flatten import FlatBatch, flatten_batch
+from .ir import compile_rule_ir
+
+
+class Verdict(IntEnum):
+    NOT_APPLICABLE = 0
+    PASS = 1
+    FAIL = 2
+    SKIP = 3
+    ERROR = 4
+    HOST = 5
+
+
+_STATUS_TO_VERDICT = {
+    RuleStatus.PASS: Verdict.PASS,
+    RuleStatus.FAIL: Verdict.FAIL,
+    RuleStatus.WARN: Verdict.PASS,
+    RuleStatus.ERROR: Verdict.ERROR,
+    RuleStatus.SKIP: Verdict.SKIP,
+}
+
+
+@dataclass
+class RuleRef:
+    policy: object          # ClusterPolicy
+    rule: object            # Rule
+    rule_index: int
+
+
+class CompiledPolicySet:
+    def __init__(self, policies: list):
+        self.policies = list(policies)
+        self.rule_refs: list[RuleRef] = []
+        rule_irs = []
+        idx = 0
+        for policy in self.policies:
+            for rule in policy.spec.rules:
+                if not rule.has_validate():
+                    continue
+                self.rule_refs.append(RuleRef(policy, rule, idx))
+                rule_irs.append(compile_rule_ir(policy, rule, idx))
+                idx += 1
+        self.rule_irs = rule_irs
+        self.tensors: PolicyTensors = compile_tensors(rule_irs)
+        self._eval_fn = None
+
+    # ------------------------------------------------------------ device
+
+    @property
+    def eval_fn(self):
+        if self._eval_fn is None:
+            from ..ops.eval import build_eval_fn
+
+            self._eval_fn = build_eval_fn(self.tensors)
+        return self._eval_fn
+
+    def flatten(self, resources: list[dict]) -> FlatBatch:
+        return flatten_batch(resources, self.tensors)
+
+    def evaluate_device(self, batch: FlatBatch) -> np.ndarray:
+        """Device verdicts [B, R] (host-lane rows = Verdict.HOST)."""
+        out = self.eval_fn(
+            batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
+            batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
+            batch.elem0, batch.kind_id, batch.host_flag, batch.str_bytes,
+            batch.str_len,
+        )
+        return np.array(out)
+
+    # ------------------------------------------------------------ full
+
+    def evaluate(self, resources: list[dict]) -> np.ndarray:
+        """Verdict matrix [B, R]: device lane + CPU oracle for HOST cells."""
+        batch = self.flatten(resources)
+        verdicts = self.evaluate_device(batch)
+        host_cells = np.argwhere(verdicts == Verdict.HOST)
+        if host_cells.size:
+            by_resource: dict[int, list[int]] = {}
+            for b, r in host_cells:
+                by_resource.setdefault(int(b), []).append(int(r))
+            for b, rule_rows in by_resource.items():
+                oracle = self._oracle_verdicts(resources[b], rule_rows)
+                for r, v in oracle.items():
+                    verdicts[b, r] = v
+        return verdicts
+
+    def _oracle_verdicts(self, resource: dict, rule_rows: list[int]) -> dict[int, int]:
+        """Run the CPU oracle for specific rules of one resource."""
+        out: dict[int, int] = {}
+        by_policy: dict[int, list[RuleRef]] = {}
+        for r in rule_rows:
+            ref = self.rule_refs[r]
+            by_policy.setdefault(id(ref.policy), []).append(ref)
+        for refs in by_policy.values():
+            policy = refs[0].policy
+            jctx = Context()
+            jctx.add_resource(resource)
+            resp = oracle_validate(
+                PolicyContext(policy=policy, new_resource=resource, json_context=jctx)
+            )
+            statuses = {rr.name: rr.status for rr in resp.policy_response.rules}
+            for ref in refs:
+                status = statuses.get(ref.rule.name)
+                if status is None:
+                    out[ref.rule_index] = Verdict.NOT_APPLICABLE
+                else:
+                    out[ref.rule_index] = _STATUS_TO_VERDICT[status]
+        return out
+
+
+def compile_policies(policies: list) -> CompiledPolicySet:
+    return CompiledPolicySet(policies)

@@ -1,0 +1,208 @@
+"""Resource batch -> leaf tensors.
+
+For every path in the compiled dictionary, enumerate the resource's slots
+(the wildcard expansion of the path), recording per slot:
+
+- ``mask``      prefix-presence bits (bit k = first k segments present on
+                this chain). ``leaf present`` is bit len(segments).
+- a *phantom slot* marks a broken chain (some map key absent): this is what
+  distinguishes "missing key -> pattern FAIL" from "empty array -> vacuous
+  PASS" (validate.go DefaultHandler vs validateArrayOfMaps over []).
+- value features: type tag, interned string id (values stringify the Go way
+  for wildcard comparison, pattern.go:309), i64 micro-units for anything
+  quantity-parseable, bool value, and the top-level element index for gate
+  alignment.
+
+Strings are interned into a per-batch dictionary; the NFA kernel matches
+patterns against the *dictionary* once and verdicts gather by id — the
+dedup that makes the string path cheap on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..utils.gofmt import value_to_string_for_equality
+from ..utils.quantity import QuantityError, parse_quantity
+from .compiler import STR_LEN, PolicyTensors
+from .ir import NUM_MAX, NUM_SCALE, SEP
+
+# type tags
+T_ABSENT, T_NULL, T_BOOL, T_NUM, T_STR, T_OBJ, T_LIST = range(7)
+
+
+@dataclass
+class FlatBatch:
+    n: int                    # batch size
+    e: int                    # slots per path
+    mask: np.ndarray          # [B, P, E] uint16 prefix bits
+    slot_valid: np.ndarray    # [B, P, E] bool
+    type_tag: np.ndarray      # [B, P, E] int8
+    str_id: np.ndarray        # [B, P, E] int32 (-1 none)
+    num_val: np.ndarray       # [B, P, E] int64 (host-side reference)
+    num_hi: np.ndarray        # [B, P, E] int32 high limb (value >> 31)
+    num_lo: np.ndarray        # [B, P, E] int32 low limb (value & 0x7FFFFFFF)
+    num_ok: np.ndarray        # [B, P, E] bool
+    bool_val: np.ndarray      # [B, P, E] bool
+    elem0: np.ndarray         # [B, P, E] int32 top-level element index (-1)
+    kind_id: np.ndarray       # [B] int32 (-1 unknown kind)
+    host_flag: np.ndarray     # [B] bool — needs the CPU oracle
+    # string dictionary
+    str_bytes: np.ndarray     # [V, STR_LEN] uint8
+    str_len: np.ndarray       # [V] int32
+    strings: list[str]
+
+
+class _Interner:
+    def __init__(self):
+        self.index: dict[str, int] = {}
+        self.strings: list[str] = []
+
+    def intern(self, s: str) -> int:
+        i = self.index.get(s)
+        if i is None:
+            i = len(self.strings)
+            self.index[s] = i
+            self.strings.append(s)
+        return i
+
+
+def _value_to_micro(value) -> int | None:
+    try:
+        if isinstance(value, bool):
+            return None
+        if isinstance(value, (int, float)):
+            from fractions import Fraction
+
+            micro = Fraction(value).limit_denominator(10**12) * NUM_SCALE
+        elif isinstance(value, str):
+            micro = parse_quantity(value) * NUM_SCALE
+        else:
+            return None
+    except (QuantityError, ValueError, OverflowError):
+        return None
+    if micro.denominator != 1 or abs(micro.numerator) > NUM_MAX:
+        return None
+    return int(micro)
+
+
+def _enumerate_slots(resource, segments: list[str]):
+    """Yield (mask, elem0, leaf_value_or_None) for every chain of
+    ``segments`` through ``resource``. A phantom slot (leaf None + short
+    mask) marks a broken chain. Empty arrays yield nothing."""
+    out = []
+
+    def walk(node, i: int, mask: int, elem0: int):
+        if i == len(segments):
+            out.append((mask, elem0, node, True))
+            return
+        seg = segments[i]
+        if seg == "*":
+            if not isinstance(node, list):
+                out.append((mask, elem0, None, False))
+                return
+            for idx, el in enumerate(node):
+                walk(el, i + 1, mask | (1 << (i + 1)), idx if elem0 < 0 else elem0)
+        else:
+            if not isinstance(node, dict) or seg not in node:
+                out.append((mask, elem0, None, False))
+                return
+            walk(node[seg], i + 1, mask | (1 << (i + 1)), elem0)
+
+    walk(resource, 0, 1, -1)  # bit 0: the root itself
+    return out
+
+
+def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int = 16) -> FlatBatch:
+    B, P = len(resources), tensors.n_paths
+    path_segments = [p.split(SEP) for p in tensors.paths]
+
+    # first pass: find E
+    all_slots: list[list] = []
+    e_needed = 1
+    host_flag = np.zeros(B, dtype=bool)
+    for b, resource in enumerate(resources):
+        row = []
+        for segs in path_segments:
+            slots = _enumerate_slots(resource, segs)
+            if len(slots) > max_slots:
+                host_flag[b] = True
+                slots = slots[:max_slots]
+            e_needed = max(e_needed, len(slots))
+            row.append(slots)
+        all_slots.append(row)
+    E = e_needed
+
+    interner = _Interner()
+    mask = np.zeros((B, P, E), dtype=np.uint16)
+    slot_valid = np.zeros((B, P, E), dtype=bool)
+    type_tag = np.full((B, P, E), T_ABSENT, dtype=np.int8)
+    str_id = np.full((B, P, E), -1, dtype=np.int32)
+    num_val = np.zeros((B, P, E), dtype=np.int64)
+    num_ok = np.zeros((B, P, E), dtype=bool)
+    bool_val = np.zeros((B, P, E), dtype=bool)
+    elem0 = np.full((B, P, E), -1, dtype=np.int32)
+    kind_id = np.full(B, -1, dtype=np.int32)
+
+    for b, resource in enumerate(resources):
+        kind = (resource.get("kind") or "") if isinstance(resource, dict) else ""
+        kind_id[b] = tensors.kind_index.get(kind, -1)
+        for p in range(P):
+            for e, (m, e0, value, leaf) in enumerate(all_slots[b][p]):
+                mask[b, p, e] = m
+                slot_valid[b, p, e] = True
+                elem0[b, p, e] = e0
+                if not leaf:
+                    continue
+                if value is None:
+                    type_tag[b, p, e] = T_NULL
+                elif isinstance(value, bool):
+                    type_tag[b, p, e] = T_BOOL
+                    bool_val[b, p, e] = value
+                    str_id[b, p, e] = interner.intern("true" if value else "false")
+                elif isinstance(value, (int, float)):
+                    type_tag[b, p, e] = T_NUM
+                    s = value_to_string_for_equality(value)
+                    if len(s) <= STR_LEN:
+                        str_id[b, p, e] = interner.intern(s)
+                    n = _value_to_micro(value)
+                    if n is not None:
+                        num_val[b, p, e] = n
+                        num_ok[b, p, e] = True
+                    else:
+                        host_flag[b] = True
+                elif isinstance(value, str):
+                    type_tag[b, p, e] = T_STR
+                    if len(value.encode("utf-8")) <= STR_LEN:
+                        str_id[b, p, e] = interner.intern(value)
+                    else:
+                        host_flag[b] = True
+                    n = _value_to_micro(value)
+                    if n is not None:
+                        num_val[b, p, e] = n
+                        num_ok[b, p, e] = True
+                elif isinstance(value, dict):
+                    type_tag[b, p, e] = T_OBJ
+                else:
+                    type_tag[b, p, e] = T_LIST
+
+    num_hi = (num_val >> 31).astype(np.int32)
+    num_lo = (num_val & 0x7FFFFFFF).astype(np.int32)
+
+    V = max(1, len(interner.strings))
+    str_bytes = np.zeros((V, STR_LEN), dtype=np.uint8)
+    str_len = np.zeros(V, dtype=np.int32)
+    for i, s in enumerate(interner.strings):
+        bs = s.encode("utf-8")[:STR_LEN]
+        str_bytes[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+        str_len[i] = len(bs)
+
+    return FlatBatch(
+        n=B, e=E, mask=mask, slot_valid=slot_valid, type_tag=type_tag,
+        str_id=str_id, num_val=num_val, num_hi=num_hi, num_lo=num_lo,
+        num_ok=num_ok, bool_val=bool_val,
+        elem0=elem0, kind_id=kind_id, host_flag=host_flag,
+        str_bytes=str_bytes, str_len=str_len, strings=interner.strings,
+    )

@@ -1,0 +1,469 @@
+"""Validate-pattern -> check IR.
+
+Compiles the recursive JSON pattern of a validate rule
+(/root/reference/pkg/engine/validate/validate.go) into a flat list of leaf
+checks. Each check is one row of the eventual pattern tensor:
+
+    (path, anchor, element-gate, op, operand)
+
+Anchors become row attributes instead of control flow
+(SURVEY.md section 7 item 1):
+  - condition ``(k)`` / global ``<(k)`` in maps  -> rule-skip predicate rows
+  - condition inside a list element              -> element gate rows
+  - equality ``=(k)``                            -> absent-passes rows
+  - negation ``X(k)``                            -> must-be-absent rows
+  - existence ``^(k)``                           -> OR-over-elements rows
+
+Rules using constructs outside the supported subset (variables, deny,
+foreach, multi-element pattern arrays, nested existence, ...) are marked
+``host_only`` and evaluated by the CPU oracle tier instead.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import IntEnum
+from fractions import Fraction
+
+from ..engine.anchors import Anchor, anchor_kind, remove_anchor
+from ..engine.pattern import Op, get_operator
+from ..engine.variables import REGEX_VARIABLES, REGEX_REFERENCES
+from ..utils.quantity import QuantityError, parse_quantity
+
+
+# Internal path separator: map keys legitimately contain "/" (label keys
+# like app.kubernetes.io/name), so segments join on a control char. Render
+# with display_path() for messages.
+SEP = "\x1f"
+
+
+def display_path(path: str) -> str:
+    return "/" + path.replace(SEP, "/")
+
+
+class CheckOp(IntEnum):
+    STR_EQ = 0        # glob match (NFA)
+    STR_NE = 1        # glob non-match
+    NUM_EQ = 2
+    NUM_NE = 3
+    NUM_GT = 4
+    NUM_GE = 5
+    NUM_LT = 6
+    NUM_LE = 7
+    NUM_IN_RANGE = 8
+    NUM_NOT_IN_RANGE = 9
+    BOOL_EQ = 10
+    IS_NULL = 11
+    EXISTS_OBJECT = 12  # pattern {} -> value must be a map
+    ABSENT = 13         # negation anchor: path must not exist
+
+
+class CheckAnchor(IntEnum):
+    NONE = 0
+    CONDITION = 1   # fail -> rule skip
+    GLOBAL = 2      # fail -> rule skip (same verdict effect at rule level)
+    EQUALITY = 3    # absent -> pass
+    ELEMENT_GATE = 4  # per-element condition inside a list
+
+
+class HostOnly(Exception):
+    """Raised during compilation when a construct needs the CPU oracle."""
+
+
+# Scaled integer representation for numbers/quantities: micro-units in i64.
+NUM_SCALE = 1_000_000
+NUM_MAX = (1 << 62) // 1
+
+
+def quantity_to_micro(value) -> int:
+    """Decompose a number or k8s quantity into i64 micro-units.
+
+    Raises HostOnly when the value cannot be represented exactly enough
+    (sub-micro precision or overflow) — those rules take the CPU lane.
+    """
+    if isinstance(value, bool):
+        raise HostOnly("bool is not numeric")
+    if isinstance(value, (int, float)):
+        frac = Fraction(value).limit_denominator(10**12)
+    else:
+        frac = parse_quantity(value)
+    micro = frac * NUM_SCALE
+    if micro.denominator != 1:
+        raise HostOnly(f"sub-micro precision: {value!r}")
+    n = int(micro)
+    if abs(n) > NUM_MAX:
+        raise HostOnly(f"quantity overflow: {value!r}")
+    return n
+
+
+@dataclass
+class CheckIR:
+    path: str                       # generalized path, "/"-joined, arrays as "*"
+    op: CheckOp
+    anchor: CheckAnchor = CheckAnchor.NONE
+    # OR semantics: checks sharing (rule, alt, group) are OR'd; groups AND'd.
+    alt: int = 0                    # anyPattern alternative index
+    group: int = 0
+    # element gating: index of the gate group this check belongs to (-1: none)
+    gate: int = -1
+    # operands
+    pattern_str: str = ""           # for STR_* (glob)
+    num_lo: int = 0                 # micro-units; for NUM_* (lo==hi for EQ)
+    num_hi: int = 0
+    bool_val: bool = False
+    # a string-op check whose operand parses as a quantity also accepts
+    # numeric resource values via numeric comparison (pattern.go:264)
+    num_fallback: bool = False
+    # OR-over-elements (existence anchor) instead of AND-over-elements
+    existence: bool = False
+    # equality-anchor guard bitmask: bit d set => if segment-prefix of depth
+    # d is the FIRST absent prefix on a slot's chain, the check passes
+    # (equality anchors at any nesting level; 0 = no guards)
+    guard_mask: int = 0
+    # for CONDITION/GLOBAL rows: segment depth of the anchored key (the
+    # predicate only applies — and can only skip — when that key exists)
+    cond_depth: int = -1
+
+
+@dataclass
+class RuleIR:
+    policy_name: str
+    rule_name: str
+    rule_index: int                  # global index into the verdict matrix
+    kinds: list[str] = field(default_factory=list)
+    namespaces: list[str] = field(default_factory=list)  # glob patterns
+    checks: list[CheckIR] = field(default_factory=list)
+    n_alts: int = 1
+    n_gates: int = 0
+    host_only: bool = False
+    host_reason: str = ""
+    # gate group -> array-prefix path (for element alignment validation)
+    gate_prefix: dict[int, str] = field(default_factory=dict)
+
+
+_HAS_VAR = re.compile("|".join([REGEX_VARIABLES.pattern, REGEX_REFERENCES.pattern]))
+
+
+def _contains_variable(node) -> bool:
+    if isinstance(node, str):
+        return bool(_HAS_VAR.search(node))
+    if isinstance(node, dict):
+        return any(_contains_variable(k) or _contains_variable(v) for k, v in node.items())
+    if isinstance(node, list):
+        return any(_contains_variable(v) for v in node)
+    return False
+
+
+class _PatternCompiler:
+    """One validate pattern (or anyPattern alternative) -> checks."""
+
+    def __init__(self, rule: RuleIR, alt: int):
+        self.rule = rule
+        self.alt = alt
+        self.group_counter = 0
+
+    def next_group(self) -> int:
+        g = self.group_counter
+        self.group_counter += 1
+        return g
+
+    def compile(self, pattern) -> None:
+        if not isinstance(pattern, dict):
+            raise HostOnly("top-level pattern must be a map")
+        self._walk_map(pattern, "", gate=-1, array_depth=0, guard=0)
+
+    # ---------------------------------------------------------------- walk
+
+    @staticmethod
+    def _segments(path: str) -> int:
+        return len(path.split(SEP)) if path else 0
+
+    def _walk_map(self, pattern: dict, path: str, gate: int, array_depth: int,
+                  guard: int) -> None:
+        for key, value in pattern.items():
+            kind = anchor_kind(key)
+            bare, _ = remove_anchor(key)
+            if "*" in bare or "?" in bare:
+                # wildcard map keys expand against the resource at match time
+                # (wildcards.ExpandInMetadata) - host lane
+                raise HostOnly("wildcard map key")
+            child_path = f"{path}{SEP}{bare}" if path else bare
+
+            if kind in (Anchor.CONDITION, Anchor.GLOBAL):
+                if array_depth > 0:
+                    # handled by _walk_list via element gates
+                    raise HostOnly("conditional anchor below an array outside a gated element")
+                anchor = (
+                    CheckAnchor.CONDITION if kind is Anchor.CONDITION else CheckAnchor.GLOBAL
+                )
+                self._compile_subtree(value, child_path, anchor, gate, array_depth,
+                                      guard, cond_depth=self._segments(child_path))
+            elif kind is Anchor.EQUALITY:
+                # =(key): absence of key (at this depth) passes; accumulate
+                # into the guard mask for every check underneath
+                self._compile_subtree(
+                    value, child_path, CheckAnchor.EQUALITY, gate, array_depth,
+                    guard=guard | (1 << self._segments(child_path)),
+                )
+            elif kind is Anchor.NEGATION:
+                self._emit(CheckIR(path=child_path, op=CheckOp.ABSENT, gate=gate,
+                                   guard_mask=guard))
+            elif kind is Anchor.EXISTENCE:
+                self._walk_existence(value, child_path)
+            elif kind is Anchor.ADD_IF_NOT_PRESENT:
+                raise HostOnly("+() anchor is mutate-only")
+            else:
+                self._compile_subtree(value, child_path, CheckAnchor.NONE, gate,
+                                      array_depth, guard)
+
+    def _compile_subtree(self, value, path: str, anchor: CheckAnchor, gate: int,
+                         array_depth: int, guard: int, cond_depth: int = -1) -> None:
+        if isinstance(value, dict):
+            if not value:
+                self._emit(CheckIR(path=path, op=CheckOp.EXISTS_OBJECT,
+                                   anchor=anchor, gate=gate, guard_mask=guard,
+                                   cond_depth=cond_depth))
+                return
+            if anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL):
+                # condition predicate subtree: leaves inherit the anchor
+                for k, v in value.items():
+                    if anchor_kind(k) is not Anchor.NONE:
+                        raise HostOnly("nested anchor inside condition subtree")
+                    self._compile_subtree(v, f"{path}{SEP}{k}", anchor, gate,
+                                          array_depth, guard, cond_depth)
+                return
+            self._walk_map(value, path, gate, array_depth, guard)
+        elif isinstance(value, list):
+            if anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL):
+                raise HostOnly("array inside condition predicate")
+            self._walk_list(value, path, anchor, array_depth, guard)
+        else:
+            if anchor is CheckAnchor.EQUALITY:
+                guard |= 1 << self._segments(path)  # scalar =(k): v self-guards
+            self._emit_leaf(value, path, anchor, gate, guard=guard,
+                            cond_depth=cond_depth)
+
+    def _walk_list(self, pattern: list, path: str, anchor: CheckAnchor,
+                   array_depth: int, guard: int) -> None:
+        """validate.go:140 validateArray: a single pattern element applies to
+        every resource element."""
+        if len(pattern) != 1:
+            raise HostOnly("multi-element pattern arrays")
+        element = pattern[0]
+        elem_path = f"{path}{SEP}*"
+        if isinstance(element, dict):
+            gates = [k for k in element if anchor_kind(k) in (Anchor.CONDITION, Anchor.GLOBAL)]
+            if gates:
+                if array_depth > 0:
+                    raise HostOnly("element gates in nested arrays")
+                gate_id = self.rule.n_gates
+                self.rule.n_gates += 1
+                self.rule.gate_prefix[gate_id] = elem_path
+                for key in gates:
+                    bare, _ = remove_anchor(key)
+                    self._compile_gate_predicate(element[key], f"{elem_path}{SEP}{bare}", gate_id)
+                rest = {k: v for k, v in element.items() if k not in gates}
+                if rest:
+                    self._walk_map(rest, elem_path, gate_id, array_depth + 1, guard)
+            else:
+                self._compile_subtree(element, elem_path, anchor, -1,
+                                      array_depth + 1, guard)
+        elif isinstance(element, list):
+            raise HostOnly("array of arrays pattern")
+        else:
+            self._emit_leaf(element, elem_path, anchor, -1, guard=guard)
+
+    def _compile_gate_predicate(self, value, path: str, gate_id: int) -> None:
+        """The anchored key's pattern becomes the gate predicate rows."""
+        if isinstance(value, (dict, list)):
+            raise HostOnly("non-scalar element gate predicate")
+        self._emit_leaf(value, path, CheckAnchor.ELEMENT_GATE, gate_id)
+
+    def _walk_existence(self, value, path: str) -> None:
+        """^(key): [pattern] -> at least one element matches. Compiled as an
+        OR-over-elements group; only a single scalar-leaf predicate or a
+        flat map of scalars is supported on device."""
+        if not isinstance(value, list) or len(value) != 1:
+            raise HostOnly("existence anchor expects a single-element list")
+        element = value[0]
+        elem_path = f"{path}{SEP}*"
+        group = self.next_group()
+        if isinstance(element, dict):
+            if len(element) != 1:
+                raise HostOnly("existence anchor over multi-key element")
+            for k, v in element.items():
+                if anchor_kind(k) is not Anchor.NONE or isinstance(v, (dict, list)):
+                    raise HostOnly("nested existence anchor")
+                self._emit_leaf(
+                    v, f"{elem_path}{SEP}{k}", CheckAnchor.NONE, -1,
+                    existence_group=group,
+                )
+        else:
+            self._emit_leaf(element, elem_path, CheckAnchor.NONE, -1, existence_group=group)
+
+    # ---------------------------------------------------------------- leaves
+
+    def _emit(self, check: CheckIR) -> None:
+        check.alt = self.alt
+        check.group = self.next_group()
+        self.rule.checks.append(check)
+
+    def _emit_leaf(self, value, path: str, anchor: CheckAnchor, gate: int,
+                   existence_group: int | None = None, guard: int = 0,
+                   cond_depth: int = -1) -> None:
+        """One scalar pattern leaf -> one or more check rows (compound
+        ``a|b`` patterns OR into the same group; pattern.go:153)."""
+        group = existence_group if existence_group is not None else self.next_group()
+        existence = existence_group is not None
+
+        if isinstance(value, bool):
+            self._append(CheckIR(path=path, op=CheckOp.BOOL_EQ, anchor=anchor,
+                                 gate=gate, group=group, bool_val=value,
+                                 guard_mask=guard, cond_depth=cond_depth),
+                         existence)
+            return
+        if value is None:
+            self._append(CheckIR(path=path, op=CheckOp.IS_NULL, anchor=anchor,
+                                 gate=gate, group=group, guard_mask=guard,
+                                 cond_depth=cond_depth), existence)
+            return
+        if isinstance(value, (int, float)):
+            n = quantity_to_micro(value)
+            self._append(CheckIR(path=path, op=CheckOp.NUM_EQ, anchor=anchor,
+                                 gate=gate, group=group, num_lo=n, num_hi=n,
+                                 guard_mask=guard, cond_depth=cond_depth),
+                         existence)
+            return
+        if not isinstance(value, str):
+            raise HostOnly(f"unsupported leaf pattern type {type(value).__name__}")
+
+        if "&" in value:
+            # AND-compound: each part its own group (pattern.go:165)
+            for part in value.split("&"):
+                self._emit_leaf(part.strip(), path, anchor, gate, guard=guard,
+                                cond_depth=cond_depth)
+            return
+
+        alternatives = [p.strip() for p in value.split("|")] if "|" in value else [value]
+        for alternative in alternatives:
+            check = self._compile_scalar(alternative, path, anchor, gate, group, guard)
+            check.cond_depth = cond_depth
+            self._append(check, existence)
+
+    def _append(self, check: CheckIR, existence: bool) -> None:
+        check.alt = self.alt
+        check.existence = existence
+        self.rule.checks.append(check)
+
+    def _compile_scalar(self, pattern: str, path: str, anchor: CheckAnchor,
+                        gate: int, group: int, guard: int) -> CheckIR:
+        op = get_operator(pattern)
+        operand = pattern[len(op.value):] if op.value and op is not Op.IN_RANGE and op is not Op.NOT_IN_RANGE else pattern
+
+        if op in (Op.MORE, Op.MORE_EQUAL, Op.LESS, Op.LESS_EQUAL):
+            n = quantity_to_micro(operand.strip())
+            num_op = {
+                Op.MORE: CheckOp.NUM_GT,
+                Op.MORE_EQUAL: CheckOp.NUM_GE,
+                Op.LESS: CheckOp.NUM_LT,
+                Op.LESS_EQUAL: CheckOp.NUM_LE,
+            }[op]
+            return CheckIR(path=path, op=num_op, anchor=anchor, gate=gate,
+                           group=group, num_lo=n, num_hi=n, guard_mask=guard)
+        if op in (Op.IN_RANGE, Op.NOT_IN_RANGE):
+            lo, hi = _split_range(pattern, op)
+            num_op = CheckOp.NUM_IN_RANGE if op is Op.IN_RANGE else CheckOp.NUM_NOT_IN_RANGE
+            return CheckIR(path=path, op=num_op, anchor=anchor, gate=gate,
+                           group=group, num_lo=lo, num_hi=hi, guard_mask=guard)
+        if op is Op.NOT_EQUAL:
+            return self._string_check(operand, path, anchor, gate, group, guard, negate=True)
+        return self._string_check(operand, path, anchor, gate, group, guard, negate=False)
+
+    def _string_check(self, operand: str, path: str, anchor: CheckAnchor,
+                      gate: int, group: int, guard: int, negate: bool) -> CheckIR:
+        check = CheckIR(
+            path=path,
+            op=CheckOp.STR_NE if negate else CheckOp.STR_EQ,
+            anchor=anchor, gate=gate, group=group, pattern_str=operand,
+            guard_mask=guard,
+        )
+        # operand parses as quantity -> numeric resource values compare
+        # numerically (pattern.go:264 validateNumberWithStr)
+        try:
+            n = quantity_to_micro(operand)
+            check.num_fallback = True
+            check.num_lo = n
+            check.num_hi = n
+        except (HostOnly, QuantityError):
+            pass
+        return check
+
+
+_RANGE_RE = re.compile(r"^(\d+(?:\.\d+)?[^-!]*?)(!?-)(\d+(?:\.\d+)?.*)$")
+
+
+def _split_range(pattern: str, op: Op) -> tuple[int, int]:
+    sep = "!-" if op is Op.NOT_IN_RANGE else "-"
+    idx = pattern.find(sep)
+    lo = pattern[:idx]
+    hi = pattern[idx + len(sep):]
+    return quantity_to_micro(lo.strip()), quantity_to_micro(hi.strip())
+
+
+def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
+    """Compile one validate rule to IR, falling back to host_only."""
+    ir = RuleIR(
+        policy_name=policy.name,
+        rule_name=rule.name,
+        rule_index=rule_index,
+        kinds=list(rule.match.resources.kinds)
+        or [k for rf in rule.match.any or rule.match.all or [] for k in rf.resources.kinds],
+        namespaces=list(rule.match.resources.namespaces),
+    )
+
+    def host(reason: str) -> RuleIR:
+        ir.host_only = True
+        ir.host_reason = reason
+        ir.checks = []
+        return ir
+
+    v = rule.validation
+    if v.foreach or v.deny is not None:
+        return host("foreach/deny rules")
+    if rule.context:
+        return host("external context")
+    if rule.preconditions is not None:
+        return host("preconditions")
+    if not rule.exclude.is_empty():
+        return host("exclude block")
+    if rule.match.any or rule.match.all:
+        return host("any/all match filters")
+    if rule.match.resources.selector or rule.match.resources.namespace_selector:
+        return host("label selectors")
+    if rule.match.resources.annotations or rule.match.resources.name or rule.match.resources.names:
+        return host("name/annotation match")
+    if not rule.match.user_info.is_empty():
+        return host("userinfo match")
+
+    patterns = []
+    if v.pattern is not None:
+        if _contains_variable(v.pattern):
+            return host("variables in pattern")
+        patterns = [v.pattern]
+    elif v.any_pattern is not None:
+        if not isinstance(v.any_pattern, list):
+            return host("malformed anyPattern")
+        if _contains_variable(v.any_pattern):
+            return host("variables in anyPattern")
+        patterns = v.any_pattern
+    else:
+        return host("no pattern")
+
+    ir.n_alts = len(patterns)
+    try:
+        for alt, pattern in enumerate(patterns):
+            _PatternCompiler(ir, alt).compile(pattern)
+    except (HostOnly, QuantityError) as e:
+        return host(str(e))
+    return ir

@@ -1,0 +1,7 @@
+"""JAX kernels for the TPU policy engine: the vectorized glob-NFA string
+matcher and the batched verdict reduction."""
+
+from .glob import glob_match_matrix
+from .eval import build_eval_fn
+
+__all__ = ["glob_match_matrix", "build_eval_fn"]

@@ -1,0 +1,71 @@
+"""Vectorized glob (wildcard) matching as an NFA bitmask simulation.
+
+The reference's leaf comparator calls minio/pkg/wildcard.Match per
+(pattern, value) pair inside the recursive tree walk
+(/root/reference/pkg/engine/validate/pattern.go:210). Here the whole
+pattern-set x string-dictionary product is computed in one shot:
+
+    match[n, v] = glob(pattern_n) accepts string_v
+
+The NFA has one state per pattern position; a boolean state vector steps
+through the value's bytes under ``lax.scan``. ``*`` states self-loop and
+epsilon-advance (consecutive stars are collapsed at compile time, so one
+propagation step per transition suffices). Everything is elementwise
+boolean math over a [N, V, S] lattice — ideal VPU work, no MXU needed, no
+data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _epsilon_closure(states, is_star_pad):
+    """Advance through '*' states without consuming input. Star runs are
+    collapsed at compile time, so a single shift suffices."""
+    advanced = jnp.pad(states[..., :-1] & is_star_pad[..., None, :-1], ((0, 0), (0, 0), (1, 0)))
+    return states | advanced
+
+
+def glob_match_matrix(nfa_char, nfa_is_star, nfa_is_q, nfa_len, str_bytes, str_len):
+    """match[n, v] for every (glob pattern n, dictionary string v).
+
+    Args (device arrays):
+      nfa_char:    [N, S] uint8 literal byte per state (0 for meta states)
+      nfa_is_star: [N, S] bool
+      nfa_is_q:    [N, S] bool
+      nfa_len:     [N]    int32 pattern length (accepting state index)
+      str_bytes:   [V, L] uint8 zero-padded string bytes
+      str_len:     [V]    int32
+    Returns: [N, V] bool
+    """
+    nfa_char, nfa_is_star, nfa_is_q, nfa_len, str_bytes, str_len = (
+        jnp.asarray(a) for a in
+        (nfa_char, nfa_is_star, nfa_is_q, nfa_len, str_bytes, str_len)
+    )
+    n, s = nfa_char.shape
+    v, l = str_bytes.shape
+
+    init = jnp.zeros((n, v, s + 1), dtype=bool).at[:, :, 0].set(True)
+    star_pad = jnp.pad(nfa_is_star, ((0, 0), (0, 1)))
+    q_pad = jnp.pad(nfa_is_q, ((0, 0), (0, 1)))
+    char_pad = jnp.pad(nfa_char, ((0, 0), (0, 1)))
+    init = _epsilon_closure(init, star_pad)
+
+    def step(states, j):
+        c = str_bytes[:, j]                                   # [V]
+        in_range = j < str_len                                # [V]
+        # consume c: state i -> i+1 when pattern[i] is '?' or == c
+        consume = q_pad[:, None, :] | (char_pad[:, None, :] == c[None, :, None])
+        advanced = jnp.pad((states & consume)[..., :-1], ((0, 0), (0, 0), (1, 0)))
+        # '*' consumes c staying in place
+        stay = states & star_pad[:, None, :]
+        new = _epsilon_closure(advanced | stay, star_pad)
+        states = jnp.where(in_range[None, :, None], new, states)
+        return states, None
+
+    states, _ = jax.lax.scan(step, init, jnp.arange(l))
+    return jnp.take_along_axis(
+        states, nfa_len[:, None, None].astype(jnp.int32), axis=2
+    )[:, :, 0]

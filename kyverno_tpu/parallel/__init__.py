@@ -1,0 +1,5 @@
+"""Mesh sharding of the policy x resource evaluation matrix."""
+
+from .mesh import make_mesh, pad_batch, sharded_eval_fn, sharded_scan
+
+__all__ = ["make_mesh", "pad_batch", "sharded_eval_fn", "sharded_scan"]

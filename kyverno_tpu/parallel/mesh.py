@@ -1,0 +1,103 @@
+"""Data-parallel sharding of the verdict matrix over a device mesh.
+
+The reference scales by running one Go process per replica and letting the
+API server fan admission requests out (SURVEY.md section 2.7). Here the
+equivalent axis is the *resource batch*: flattened resource tensors shard
+over the mesh's ``data`` axis, every device holds the (small, replicated)
+policy tensors, and the only cross-device traffic is the verdict-count
+all-reduce for report aggregation — a psum over ICI, the TPU analogue of
+the ReportChangeRequest fan-in (/root/reference/pkg/policyreport).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.engine import CompiledPolicySet
+from ..models.flatten import FlatBatch
+from ..ops.eval import V_FAIL, V_PASS
+
+
+def make_mesh(devices=None, axis: str = "data") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
+    """Pad the batch axis to a multiple of the mesh size. Padded rows carry
+    kind_id=-1 so every rule reports NOT_APPLICABLE for them."""
+    b = batch.n
+    padded = (b + multiple - 1) // multiple * multiple
+    if padded == b:
+        return batch, b
+    pad = padded - b
+
+    def pb(x):
+        width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, width)
+
+    return FlatBatch(
+        n=padded, e=batch.e,
+        mask=pb(batch.mask), slot_valid=pb(batch.slot_valid),
+        type_tag=pb(batch.type_tag), str_id=pb(batch.str_id),
+        num_val=pb(batch.num_val), num_hi=pb(batch.num_hi),
+        num_lo=pb(batch.num_lo), num_ok=pb(batch.num_ok),
+        bool_val=pb(batch.bool_val), elem0=pb(batch.elem0),
+        kind_id=np.pad(batch.kind_id, (0, pad), constant_values=-1),
+        host_flag=np.pad(batch.host_flag, (0, pad)),
+        str_bytes=batch.str_bytes, str_len=batch.str_len,
+        strings=batch.strings,
+    ), b
+
+
+def _batch_arrays(batch: FlatBatch) -> tuple:
+    return (batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
+            batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
+            batch.elem0, batch.kind_id, batch.host_flag)
+
+
+def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
+    """jit the verdict computation with the batch axis sharded over the
+    mesh; XLA partitions the whole dataflow (GSPMD), no collectives needed
+    until the count reduction."""
+    from ..ops.eval import build_eval_fn
+
+    base = build_eval_fn(cps.tensors, jit=False)
+    data = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+
+    def step(mask, slot_valid, type_tag, str_id, num_hi, num_lo, num_ok,
+             bool_val, elem0, kind_id, host_flag, str_bytes, str_len):
+        verdict = base(mask, slot_valid, type_tag, str_id, num_hi, num_lo,
+                       num_ok, bool_val, elem0, kind_id, host_flag,
+                       str_bytes, str_len)
+        # report aggregation: per-rule pass/fail counts across the whole
+        # sharded batch -> all-reduce over ICI
+        fails = jnp.sum(verdict == V_FAIL, axis=0)
+        passes = jnp.sum(verdict == V_PASS, axis=0)
+        return verdict, fails, passes
+
+    return jax.jit(
+        step,
+        in_shardings=tuple([data] * 11 + [repl, repl]),
+        out_shardings=(data, repl, repl),
+    )
+
+
+def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
+                 axis: str = "data"):
+    """Background-scan entry: flatten, pad to the mesh, evaluate sharded.
+
+    Returns (verdicts [B, R] numpy, fails [R], passes [R]) — the mesh-scale
+    replay of /root/reference/pkg/policy/existing.go:20
+    processExistingResources.
+    """
+    batch = cps.flatten(resources)
+    batch, n = pad_batch(batch, mesh.devices.size)
+    fn = sharded_eval_fn(cps, mesh, axis)
+    verdict, fails, passes = fn(*_batch_arrays(batch), batch.str_bytes,
+                                batch.str_len)
+    return np.array(verdict)[:n], np.array(fails), np.array(passes)

@@ -1,0 +1,230 @@
+"""TPU tier vs CPU oracle: every device verdict must equal the oracle's.
+
+This is the conformance harness from SURVEY.md section 4 ("same test.yaml,
+two backends, diff the verdict matrices"): the best_practices policy corpus
+plus synthetic anchor-heavy policies are evaluated against a randomized pod
+corpus on both tiers; any disagreement on a device-lane cell is a bug.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policies_from_path, load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+from kyverno_tpu.engine.validation import validate as oracle_validate
+from kyverno_tpu.models import CompiledPolicySet, Verdict
+
+_STATUS_TO_VERDICT = {
+    RuleStatus.PASS: Verdict.PASS,
+    RuleStatus.FAIL: Verdict.FAIL,
+    RuleStatus.WARN: Verdict.PASS,
+    RuleStatus.ERROR: Verdict.ERROR,
+    RuleStatus.SKIP: Verdict.SKIP,
+}
+
+
+def oracle_matrix(cps: CompiledPolicySet, resources: list[dict]) -> np.ndarray:
+    out = np.zeros((len(resources), cps.tensors.n_rules), dtype=np.int8)
+    for b, resource in enumerate(resources):
+        for policy in cps.policies:
+            jctx = Context()
+            jctx.add_resource(resource)
+            resp = oracle_validate(
+                PolicyContext(policy=policy, new_resource=resource, json_context=jctx)
+            )
+            statuses = {rr.name: rr.status for rr in resp.policy_response.rules}
+            for ref in cps.rule_refs:
+                if ref.policy is policy and ref.rule.name in statuses:
+                    out[b, ref.rule_index] = _STATUS_TO_VERDICT[statuses[ref.rule.name]]
+    return out
+
+
+def random_pod(rng: random.Random) -> dict:
+    def maybe(p, v, default=None):
+        return v if rng.random() < p else default
+
+    containers = []
+    for i in range(rng.randint(0, 3)):
+        c = {"name": rng.choice(["web", "app", "sidecar", ""]) or f"c{i}"}
+        image = rng.choice(
+            ["nginx:latest", "nginx:1.21", "redis", "registry.io/a/b:v2", "busybox:stable"]
+        )
+        if rng.random() < 0.9:
+            c["image"] = image
+        if rng.random() < 0.4:
+            c["securityContext"] = {}
+            if rng.random() < 0.7:
+                c["securityContext"]["privileged"] = rng.random() < 0.5
+            if rng.random() < 0.5:
+                c["securityContext"]["allowPrivilegeEscalation"] = rng.random() < 0.5
+        if rng.random() < 0.5:
+            res = {}
+            if rng.random() < 0.8:
+                res["requests"] = {
+                    k: v
+                    for k, v in (
+                        ("memory", maybe(0.8, rng.choice(["64Mi", "1Gi", "100M"]))),
+                        ("cpu", maybe(0.7, rng.choice(["100m", "1", "0.5"]))),
+                    )
+                    if v
+                }
+            if rng.random() < 0.6:
+                res["limits"] = {
+                    k: v
+                    for k, v in (("memory", maybe(0.8, rng.choice(["128Mi", "2Gi"]))),)
+                    if v
+                }
+            c["resources"] = res
+        if rng.random() < 0.3:
+            ports = []
+            for _ in range(rng.randint(0, 2)):
+                port = {"containerPort": rng.randint(1, 65535)}
+                if rng.random() < 0.4:
+                    port["hostPort"] = rng.randint(1, 65535)
+                ports.append(port)
+            c["ports"] = ports
+        containers.append(c)
+
+    pod = {
+        "apiVersion": "v1",
+        "kind": rng.choice(["Pod", "Pod", "Pod", "Service", "Deployment"]),
+        "metadata": {"name": f"pod-{rng.randint(0, 999)}"},
+        "spec": {},
+    }
+    if containers or rng.random() < 0.8:
+        pod["spec"]["containers"] = containers
+    if rng.random() < 0.4:
+        labels = {}
+        if rng.random() < 0.7:
+            labels["app.kubernetes.io/name"] = rng.choice(["x", ""])
+        if rng.random() < 0.5:
+            labels["app.kubernetes.io/component"] = "api"
+        pod["metadata"]["labels"] = labels
+    if rng.random() < 0.3:
+        pod["spec"]["hostNetwork"] = rng.random() < 0.5
+    if rng.random() < 0.2:
+        pod["spec"]["hostPID"] = rng.random() < 0.5
+    if rng.random() < 0.3:
+        vols = []
+        for i in range(rng.randint(0, 2)):
+            vol = {"name": f"v{i}"}
+            if rng.random() < 0.5:
+                vol["hostPath"] = {"path": "/var/run/docker.sock"}
+            else:
+                vol["emptyDir"] = {}
+            vols.append(vol)
+        pod["spec"]["volumes"] = vols
+    if rng.random() < 0.2:
+        pod["spec"]["securityContext"] = (
+            {"sysctls": [{"name": "net.core.somaxconn", "value": "1024"}]}
+            if rng.random() < 0.5
+            else {}
+        )
+    return pod
+
+
+SYNTHETIC_POLICIES = [
+    # element gates: containers with :latest images must pull Always
+    {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "synthetic-gate"},
+        "spec": {"rules": [{
+            "name": "latest-pull-always",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"pattern": {"spec": {"containers": [
+                {"(image)": "*:latest", "imagePullPolicy": "Always"}
+            ]}}},
+        }]},
+    },
+    # anyPattern
+    {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "synthetic-anypattern"},
+        "spec": {"rules": [{
+            "name": "nginx-or-redis",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"anyPattern": [
+                {"spec": {"containers": [{"image": "nginx:*"}]}},
+                {"spec": {"containers": [{"image": "redis*"}]}},
+            ]},
+        }]},
+    },
+    # numeric operators + ranges + compound
+    {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "synthetic-numeric"},
+        "spec": {"rules": [{
+            "name": "port-range",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"pattern": {"spec": {"containers": [
+                {"ports": [{"containerPort": "1024-65535"}]}
+            ]}}},
+        }]},
+    },
+    # condition anchor at map level
+    {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "synthetic-cond"},
+        "spec": {"rules": [{
+            "name": "hostnetwork-requires-label",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"pattern": {
+                "spec": {"(hostNetwork)": True},
+                "metadata": {"labels": {"app.kubernetes.io/name": "?*"}},
+            }},
+        }]},
+    },
+]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = random.Random(20260729)
+    return [random_pod(rng) for _ in range(120)]
+
+
+@pytest.fixture(scope="module")
+def policy_set():
+    policies = load_policies_from_path("/root/reference/test/best_practices/")
+    policies += [load_policy(doc) for doc in SYNTHETIC_POLICIES]
+    return CompiledPolicySet(policies)
+
+
+def test_device_lane_compiles_most_rules(policy_set):
+    hosts = [r for r in policy_set.rule_irs if r.host_only]
+    assert len(hosts) <= 2, [(h.rule_name, h.host_reason) for h in hosts]
+
+
+def test_cross_check_verdicts(policy_set, corpus):
+    batch = policy_set.flatten(corpus)
+    device = policy_set.evaluate_device(batch)
+    oracle = oracle_matrix(policy_set, corpus)
+
+    mismatches = []
+    for b in range(len(corpus)):
+        for r in range(policy_set.tensors.n_rules):
+            got = Verdict(device[b, r])
+            if got == Verdict.HOST:
+                continue  # host lane defers to the oracle by construction
+            want = Verdict(oracle[b, r])
+            if got != want:
+                ref = policy_set.rule_refs[r]
+                mismatches.append(
+                    (b, ref.policy.name, ref.rule.name, want.name, got.name,
+                     corpus[b])
+                )
+    assert not mismatches, f"{len(mismatches)} mismatches; first: {mismatches[0]}"
+
+
+def test_full_evaluate_matches_oracle(policy_set, corpus):
+    verdicts = policy_set.evaluate(corpus[:30])
+    oracle = oracle_matrix(policy_set, corpus[:30])
+    assert (verdicts == oracle).all()

@@ -1,0 +1,54 @@
+"""Glob NFA kernel vs the host wildcard oracle (utils/wildcard.py)."""
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.models.compiler import NFA_STATES, STR_LEN, _compile_glob
+from kyverno_tpu.ops.glob import glob_match_matrix
+from kyverno_tpu.utils.wildcard import wildcard_match
+
+PATTERNS = [
+    "*", "?*", "*:latest", "!ignored", "nginx:*", "*:*", "a*b*c", "???",
+    "exact", "", "*.yaml", "a?c", "**", "*a*", "registry.io/*/img:*",
+]
+
+STRINGS = [
+    "", "a", "abc", "nginx:latest", "nginx:1.21", "exact", "exact!",
+    "aXbYc", "abcabc", "x.yaml", "yaml", "registry.io/team/img:v1",
+    "a:b:c", "latest", ":latest", "aaa",
+]
+
+
+@pytest.fixture(scope="module")
+def match_matrix():
+    rows = [_compile_glob(p) for p in PATTERNS]
+    assert all(r is not None for r in rows)
+    nfa_char = np.stack([r[0] for r in rows])
+    nfa_star = np.stack([r[1] for r in rows])
+    nfa_q = np.stack([r[2] for r in rows])
+    nfa_len = np.array([r[3] for r in rows], dtype=np.int32)
+    str_bytes = np.zeros((len(STRINGS), STR_LEN), dtype=np.uint8)
+    str_len = np.zeros(len(STRINGS), dtype=np.int32)
+    for i, s in enumerate(STRINGS):
+        bs = s.encode()
+        str_bytes[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+        str_len[i] = len(bs)
+    return np.asarray(
+        glob_match_matrix(nfa_char, nfa_star, nfa_q, nfa_len, str_bytes, str_len)
+    )
+
+
+def test_matches_wildcard_oracle(match_matrix):
+    mismatches = []
+    for i, pattern in enumerate(PATTERNS):
+        for j, s in enumerate(STRINGS):
+            want = wildcard_match(pattern, s)
+            got = bool(match_matrix[i, j])
+            if want != got:
+                mismatches.append((pattern, s, want, got))
+    assert not mismatches, mismatches
+
+
+def test_long_pattern_rejected():
+    assert _compile_glob("x" * NFA_STATES) is None
+    assert _compile_glob("é*") is None

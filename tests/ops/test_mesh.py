@@ -1,0 +1,43 @@
+"""Sharded evaluation over the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policies_from_path
+from kyverno_tpu.models import CompiledPolicySet, Verdict
+from kyverno_tpu.parallel import make_mesh, sharded_scan
+
+
+@pytest.fixture(scope="module")
+def cps():
+    return CompiledPolicySet(
+        load_policies_from_path("/root/reference/test/best_practices/")
+    )
+
+
+def make_pod(i: int) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"p{i}"},
+        "spec": {"containers": [
+            {"name": "c", "image": "nginx:latest" if i % 2 else "nginx:1.21"}
+        ]},
+    }
+
+
+def test_sharded_scan_matches_single_device(cps):
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    mesh = make_mesh()
+    resources = [make_pod(i) for i in range(21)]  # deliberately non-multiple
+    verdicts, fails, passes = sharded_scan(cps, resources, mesh)
+    assert verdicts.shape[0] == 21
+
+    single = cps.evaluate_device(cps.flatten(resources))
+    assert (verdicts == single).all()
+
+    # report aggregation counts (over the padded batch; padding rows are
+    # NOT_APPLICABLE so they do not count)
+    want_fails = (single == Verdict.FAIL).sum(axis=0)
+    np.testing.assert_array_equal(fails, want_fails)
