@@ -1,0 +1,5 @@
+"""kubectl-kyverno style CLI: apply, test, validate.
+
+Mirrors /root/reference/pkg/kyverno (cobra CLI; verbs at main.go:27-30).
+Run as ``python -m kyverno_tpu.cli <verb> ...``.
+"""

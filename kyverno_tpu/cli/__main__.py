@@ -1,0 +1,31 @@
+"""CLI dispatcher (mirrors /root/reference/pkg/kyverno/main.go:18 CLI())."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .. import __version__
+from . import apply_cmd, test_cmd, validate_cmd
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kyverno-tpu",
+        description="TPU-native Kubernetes policy engine CLI",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    subparsers = parser.add_subparsers(dest="command")
+    apply_cmd.register(subparsers)
+    test_cmd.register(subparsers)
+    validate_cmd.register(subparsers)
+
+    args = parser.parse_args(argv)
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 2
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

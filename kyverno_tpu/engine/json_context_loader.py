@@ -52,10 +52,10 @@ def _load_mock_context(policy_name: str, rule_name: str, ctx: Context) -> None:
             trimmed = value.strip("\n")
             if "\n" in trimmed:
                 value = parse_multiline_block_body({key: value})[key]
-        ctx.add_json(_variable_to_json(key, value))
+        ctx.add_json(variable_to_json(key, value))
 
 
-def _variable_to_json(key: str, value) -> dict:
+def variable_to_json(key: str, value) -> dict:
     """pkg/common VariableToJSON: dotted keys nest ("a.b.c" -> {a:{b:{c:v}}});
     JSON-looking string values parse structurally."""
     if isinstance(value, str):

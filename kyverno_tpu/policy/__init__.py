@@ -1,0 +1,1 @@
+"""Policy lifecycle: autogen, validation, cache, background scan."""

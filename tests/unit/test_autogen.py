@@ -1,0 +1,103 @@
+"""Autogen (pod-controller rule generation) tests, mirroring
+/root/reference/pkg/policymutation/policymutation_test.go."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.policy.autogen import (
+    can_auto_gen,
+    generate_pod_controller_rules,
+    mutate_policy_for_autogen,
+)
+
+
+def pod_policy(rule_extra=None, annotations=None):
+    rule = {
+        "name": "check-labels",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {
+            "message": "label required",
+            "pattern": {"metadata": {"labels": {"app": "?*"}}},
+        },
+    }
+    rule.update(rule_extra or {})
+    return {
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "p", "annotations": annotations or {}},
+        "spec": {"rules": [rule]},
+    }
+
+
+class TestCanAutoGen:
+    def test_pod_rule_autogens(self):
+        ok, controllers = can_auto_gen(pod_policy())
+        assert ok and controllers == "DaemonSet,Deployment,Job,StatefulSet,CronJob"
+
+    def test_name_match_blocks(self):
+        doc = pod_policy()
+        doc["spec"]["rules"][0]["match"]["resources"]["name"] = "foo"
+        assert can_auto_gen(doc) == (False, "none")
+
+    def test_mixed_kinds_block(self):
+        doc = pod_policy()
+        doc["spec"]["rules"][0]["match"]["resources"]["kinds"] = ["Pod", "Deployment"]
+        assert can_auto_gen(doc) == (False, "none")
+
+    def test_deny_blocks(self):
+        doc = pod_policy({"validate": {"deny": {"conditions": []}}})
+        assert can_auto_gen(doc) == (False, "none")
+
+
+class TestGenerateRules:
+    def test_pattern_wrapped_under_template(self):
+        rules = generate_pod_controller_rules(pod_policy())
+        by_name = {r["name"]: r for r in rules}
+        assert set(by_name) == {"autogen-check-labels", "autogen-cronjob-check-labels"}
+
+        auto = by_name["autogen-check-labels"]
+        assert auto["match"]["resources"]["kinds"] == [
+            "DaemonSet", "Deployment", "Job", "StatefulSet"
+        ]
+        assert auto["validate"]["pattern"] == {
+            "spec": {"template": {"metadata": {"labels": {"app": "?*"}}}}
+        }
+
+        cron = by_name["autogen-cronjob-check-labels"]
+        assert cron["match"]["resources"]["kinds"] == ["CronJob"]
+        assert cron["validate"]["pattern"] == {
+            "spec": {"jobTemplate": {"spec": {"template": {"metadata": {"labels": {"app": "?*"}}}}}}
+        }
+
+    def test_variables_shift_into_template(self):
+        doc = pod_policy({
+            "validate": {
+                "message": "bad {{request.object.spec.containers[0].image}}",
+                "pattern": {"spec": {"containers": [{"image": "?*"}]}},
+            }
+        })
+        rules = generate_pod_controller_rules(doc)
+        auto = next(r for r in rules if r["name"] == "autogen-check-labels")
+        assert "request.object.spec.template.spec.containers" in auto["validate"]["message"]
+        cron = next(r for r in rules if "cronjob" in r["name"])
+        assert (
+            "request.object.spec.jobTemplate.spec.template.spec.containers"
+            in cron["validate"]["message"]
+        )
+
+    def test_annotation_none_disables(self):
+        doc = pod_policy(
+            annotations={"pod-policies.kyverno.io/autogen-controllers": "none"}
+        )
+        assert generate_pod_controller_rules(doc) == []
+
+    def test_annotation_subset(self):
+        doc = pod_policy(
+            annotations={"pod-policies.kyverno.io/autogen-controllers": "Deployment"}
+        )
+        rules = generate_pod_controller_rules(doc)
+        assert len(rules) == 1
+        assert rules[0]["match"]["resources"]["kinds"] == ["Deployment"]
+
+    def test_mutate_policy_defaults(self):
+        policy = mutate_policy_for_autogen(load_policy(pod_policy()))
+        assert policy.spec.validation_failure_action == "audit"
+        assert len(policy.spec.rules) == 3

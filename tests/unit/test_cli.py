@@ -1,0 +1,48 @@
+"""CLI conformance: replay the reference's own test corpus.
+
+SURVEY.md section 4 tier 4: the per-(policy, rule, resource) status tables
+under /root/reference/test/cli are the cross-backend regression corpus."""
+
+import os
+
+import pytest
+
+from kyverno_tpu.cli.test_cmd import run_test_file
+from kyverno_tpu.cli.__main__ import main
+
+REFERENCE_CORPORA = [
+    "/root/reference/test/cli/test/simple",
+    "/root/reference/test/cli/test/preconditions",
+    "/root/reference/test/cli/test/variables",
+    "/root/reference/test/cli/test/custom-functions",
+    "/root/reference/test/cli/test/autogen",
+    "/root/reference/test/cli/test-mutate",
+]
+
+
+@pytest.mark.parametrize("corpus", REFERENCE_CORPORA, ids=os.path.basename)
+def test_reference_cli_corpus(corpus):
+    mismatches = run_test_file(os.path.join(corpus, "test.yaml"), verbose=False)
+    assert mismatches == 0
+
+
+def test_negative_suite_fails():
+    assert main(["test", "/root/reference/test/cli/test-fail/missing-policy"]) == 1
+
+
+def test_apply_reports_failures(capsys):
+    rc = main([
+        "apply",
+        "/root/reference/test/best_practices/disallow_latest_tag.yaml",
+        "-r", "/root/reference/test/resources/pod_with_latest_tag.yaml",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "fail: 1" in out
+    assert "validate-image-tag" in out
+
+
+def test_validate_verb(capsys):
+    rc = main(["validate", "/root/reference/test/best_practices/disallow_latest_tag.yaml"])
+    assert rc == 0
+    assert "is valid" in capsys.readouterr().out

@@ -1,0 +1,212 @@
+"""Generate engine tests (filter + materialization)."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.generation import (
+    MODE_CREATE,
+    MODE_SKIP,
+    MODE_UPDATE,
+    apply_generate_rule,
+    generate,
+)
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+
+
+class FakeClient:
+    def __init__(self, resources=None):
+        self.resources = resources or {}
+
+    def get_resource(self, api_version, kind, namespace, name):
+        return self.resources.get((kind, namespace, name))
+
+    def list_resource(self, api_version, kind, namespace):
+        return [v for (k, ns, _), v in self.resources.items()
+                if k == kind and (not namespace or ns == namespace)]
+
+    def get_configmap(self, namespace, name):
+        return self.resources.get(("ConfigMap", namespace, name))
+
+
+GEN_POLICY = {
+    "apiVersion": "kyverno.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "add-networkpolicy"},
+    "spec": {"rules": [{
+        "name": "default-deny",
+        "match": {"resources": {"kinds": ["Namespace"]}},
+        "generate": {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "name": "default-deny",
+            "namespace": "{{request.object.metadata.name}}",
+            "synchronize": True,
+            "data": {
+                "spec": {"podSelector": {}, "policyTypes": ["Ingress", "Egress"]}
+            },
+        },
+    }]},
+}
+
+
+def make_ctx(policy_doc, resource, client=None):
+    jctx = Context()
+    jctx.add_resource(resource)
+    return PolicyContext(
+        policy=load_policy(policy_doc), new_resource=resource,
+        json_context=jctx, client=client,
+    )
+
+
+NAMESPACE = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "team-a"}}
+
+
+class TestGenerateFilter:
+    def test_matching_resource_produces_pass_row(self):
+        resp = generate(make_ctx(GEN_POLICY, NAMESPACE))
+        assert [r.status for r in resp.policy_response.rules] == [RuleStatus.PASS]
+
+    def test_non_matching_kind_produces_nothing(self):
+        pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p"}}
+        resp = generate(make_ctx(GEN_POLICY, pod))
+        assert resp.policy_response.rules == []
+
+    def test_old_resource_match_produces_fail_row(self):
+        pod = {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p"}}
+        ctx = make_ctx(GEN_POLICY, pod)
+        ctx.old_resource = NAMESPACE
+        resp = generate(ctx)
+        assert [r.status for r in resp.policy_response.rules] == [RuleStatus.FAIL]
+
+
+class TestMaterialization:
+    def test_data_create_with_variables(self):
+        ctx = make_ctx(GEN_POLICY, NAMESPACE, client=FakeClient())
+        rule = ctx.policy.spec.rules[0]
+        resource, mode = apply_generate_rule(rule, ctx, NAMESPACE, ctx.client)
+        assert mode == MODE_CREATE
+        assert resource["kind"] == "NetworkPolicy"
+        assert resource["metadata"]["namespace"] == "team-a"  # substituted
+        labels = resource["metadata"]["labels"]
+        assert labels["kyverno.io/generated-by-policy"] == "add-networkpolicy"
+        assert labels["kyverno.io/generated-by-name"] == "team-a"
+
+    def test_data_update_when_target_exists(self):
+        existing = {"metadata": {"resourceVersion": "42"}}
+        client = FakeClient({("NetworkPolicy", "team-a", "default-deny"): existing})
+        ctx = make_ctx(GEN_POLICY, NAMESPACE, client=client)
+        rule = ctx.policy.spec.rules[0]
+        resource, mode = apply_generate_rule(rule, ctx, NAMESPACE, client)
+        assert mode == MODE_UPDATE
+        assert resource["metadata"]["resourceVersion"] == "42"
+
+    def test_clone(self):
+        source = {
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "regcred", "namespace": "default",
+                         "resourceVersion": "7", "uid": "u1"},
+            "data": {"token": "eA=="},
+        }
+        client = FakeClient({("Secret", "default", "regcred"): source})
+        policy = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "clone-secret"},
+            "spec": {"rules": [{
+                "name": "clone-regcred",
+                "match": {"resources": {"kinds": ["Namespace"]}},
+                "generate": {
+                    "apiVersion": "v1", "kind": "Secret", "name": "regcred",
+                    "namespace": "{{request.object.metadata.name}}",
+                    "clone": {"namespace": "default", "name": "regcred"},
+                },
+            }]},
+        }
+        ctx = make_ctx(policy, NAMESPACE, client=client)
+        rule = ctx.policy.spec.rules[0]
+        resource, mode = apply_generate_rule(rule, ctx, NAMESPACE, client)
+        assert mode == MODE_CREATE
+        assert resource["data"] == {"token": "eA=="}
+        assert resource["metadata"]["namespace"] == "team-a"
+        assert "resourceVersion" not in resource["metadata"]
+        assert "uid" not in resource["metadata"]
+
+    def test_self_clone_skips(self):
+        policy = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "self-clone"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Namespace"]}},
+                "generate": {
+                    "apiVersion": "v1", "kind": "Secret", "name": "s",
+                    "namespace": "ns", "clone": {"namespace": "ns", "name": "s"},
+                },
+            }]},
+        }
+        ctx = make_ctx(policy, NAMESPACE, client=FakeClient())
+        resource, mode = apply_generate_rule(
+            ctx.policy.spec.rules[0], ctx, NAMESPACE, ctx.client
+        )
+        assert mode == MODE_SKIP and resource is None
+
+
+class TestPolicyValidation:
+    def test_valid_policy(self):
+        from kyverno_tpu.policy.validation import validate_policy
+
+        assert validate_policy(load_policy(GEN_POLICY)) == []
+
+    def test_multiple_actions_invalid(self):
+        from kyverno_tpu.policy.validation import validate_policy
+
+        doc = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "bad"},
+            "spec": {"rules": [{
+                "name": "two-actions",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"pattern": {"spec": {}}},
+                "mutate": {"patchStrategicMerge": {"metadata": {}}},
+            }]},
+        }
+        errors = validate_policy(load_policy(doc))
+        assert any("multiple operations" in e for e in errors)
+
+    def test_duplicate_rule_names(self):
+        from kyverno_tpu.policy.validation import validate_policy
+
+        doc = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "dup"},
+            "spec": {"rules": [
+                {"name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                 "validate": {"pattern": {"spec": {}}}},
+                {"name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                 "validate": {"pattern": {"spec": {}}}},
+            ]},
+        }
+        errors = validate_policy(load_policy(doc))
+        assert any("duplicate rule name" in e for e in errors)
+
+    def test_unknown_variable_flagged(self):
+        from kyverno_tpu.policy.validation import validate_policy
+
+        doc = {
+            "apiVersion": "kyverno.io/v1",
+            "kind": "ClusterPolicy",
+            "metadata": {"name": "vars"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {
+                    "message": "{{undefinedthing.foo}}",
+                    "pattern": {"spec": {}},
+                },
+            }]},
+        }
+        errors = validate_policy(load_policy(doc))
+        assert any("not defined in the rule context" in e for e in errors)
