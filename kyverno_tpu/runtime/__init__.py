@@ -1,0 +1,2 @@
+"""Serving & control plane: policy cache, webhook server, dynamic config,
+reports, events, metrics, background scan, generate controller."""

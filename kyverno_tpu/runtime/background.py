@@ -1,0 +1,147 @@
+"""Background scan: validate existing resources against the policy set.
+
+Mirrors /root/reference/pkg/policy (processExistingResources,
+existing.go:20) with the TPU twist: instead of the reference's serial
+per-resource loop on 2 workers, the whole snapshot is flattened once and
+scored as a policy x resource matrix on device (CompiledPolicySet), with
+the CPU oracle lane for host-only rules — the mesh-scale replay of
+BASELINE.md config [5]. Results feed the report pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..engine.response import (
+    EngineResponse,
+    PolicyResponse,
+    PolicySpecSummary,
+    ResourceSpec,
+    RuleResponse,
+    RuleStatus,
+    RuleType,
+)
+from ..models import CompiledPolicySet, Verdict
+from .reports import ReportGenerator
+
+_VERDICT_TO_STATUS = {
+    Verdict.PASS: RuleStatus.PASS,
+    Verdict.FAIL: RuleStatus.FAIL,
+    Verdict.SKIP: RuleStatus.SKIP,
+    Verdict.ERROR: RuleStatus.ERROR,
+}
+
+
+@dataclass
+class ScanResult:
+    resources_scanned: int = 0
+    rules_evaluated: int = 0
+    violations: int = 0
+    duration_s: float = 0.0
+    responses: list[EngineResponse] = field(default_factory=list)
+
+
+class ResourceManager:
+    """existing.go:125 ResourceManager: TTL'd dedup of scanned resources."""
+
+    def __init__(self, ttl_s: float = 3600.0):
+        self.ttl_s = ttl_s
+        self._seen: dict[str, float] = {}
+
+    def process_resource(self, policy: str, kind: str, namespace: str,
+                         name: str, rv: str) -> bool:
+        key = f"{policy}/{kind}/{namespace}/{name}/{rv}"
+        now = time.monotonic()
+        stamp = self._seen.get(key)
+        if stamp is not None and now - stamp < self.ttl_s:
+            return False
+        self._seen[key] = now
+        return True
+
+    def drop(self) -> None:
+        self._seen.clear()
+
+
+class BackgroundScanner:
+    """PolicyController's scan half (policy_controller.go:119 + existing.go)."""
+
+    def __init__(self, policies: list, client=None,
+                 report_gen: ReportGenerator | None = None, mesh=None):
+        self.policies = [p for p in policies if p.spec.background]
+        self.client = client
+        self.report_gen = report_gen
+        self.mesh = mesh
+        self.resource_manager = ResourceManager()
+        self.cps = CompiledPolicySet(self.policies)
+
+    def kinds(self) -> list[str]:
+        out: list[str] = []
+        for ir in self.cps.rule_irs:
+            for kind in ir.kinds:
+                bare = kind.split("/")[-1]
+                if bare not in out:
+                    out.append(bare)
+        return out
+
+    def snapshot(self) -> list[dict]:
+        """getResourcesPerNamespace via the client (existing.go:214)."""
+        if self.client is None:
+            return []
+        resources = []
+        for kind in self.kinds():
+            if kind == "*":
+                continue
+            resources.extend(self.client.list_resource("", kind))
+        return resources
+
+    def scan(self, resources: list[dict] | None = None) -> ScanResult:
+        start = time.monotonic()
+        resources = resources if resources is not None else self.snapshot()
+        result = ScanResult(resources_scanned=len(resources))
+        if not resources:
+            return result
+
+        if self.mesh is not None:
+            from ..parallel import sharded_scan
+
+            verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
+        else:
+            verdicts = self.cps.evaluate(resources)
+
+        for b, resource in enumerate(resources):
+            meta = resource.get("metadata") or {}
+            per_policy: dict[str, EngineResponse] = {}
+            for ref in self.cps.rule_refs:
+                verdict = Verdict(verdicts[b, ref.rule_index])
+                if verdict is Verdict.NOT_APPLICABLE:
+                    continue
+                status = _VERDICT_TO_STATUS.get(verdict)
+                if status is None:
+                    continue
+                result.rules_evaluated += 1
+                if status is RuleStatus.FAIL:
+                    result.violations += 1
+                resp = per_policy.get(ref.policy.name)
+                if resp is None:
+                    resp = EngineResponse(policy_response=PolicyResponse(
+                        policy=PolicySpecSummary(name=ref.policy.name),
+                        resource=ResourceSpec(
+                            kind=resource.get("kind", ""),
+                            api_version=resource.get("apiVersion", ""),
+                            namespace=meta.get("namespace", ""),
+                            name=meta.get("name", ""),
+                        ),
+                    ))
+                    per_policy[ref.policy.name] = resp
+                resp.policy_response.rules.append(RuleResponse(
+                    name=ref.rule.name, type=RuleType.VALIDATION, status=status,
+                    message=f"validation rule '{ref.rule.name}' "
+                            f"{'passed' if status is RuleStatus.PASS else status.value}",
+                ))
+            result.responses.extend(per_policy.values())
+
+        if self.report_gen is not None:
+            self.report_gen.add(*result.responses)
+        result.duration_s = time.monotonic() - start
+        return result

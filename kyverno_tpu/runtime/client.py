@@ -1,0 +1,239 @@
+"""Cluster access: the dclient equivalent.
+
+Mirrors /root/reference/pkg/dclient/client.go's surface (Get/List/Create/
+Update/Delete of unstructured resources + ConfigMap lookups) behind one
+interface with two implementations:
+
+- :class:`FakeCluster` — in-memory store for tests, the CLI, and snapshot
+  replays (the resourcecache analogue for offline runs)
+- :class:`RestClient` — a minimal stdlib-urllib client against a real API
+  server (bearer-token kubeconfig), for in-cluster deployment
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import urllib.request
+from dataclasses import dataclass, field
+
+
+class Client:
+    """The engine-facing surface (PolicyContext.client)."""
+
+    def get_resource(self, api_version: str, kind: str, namespace: str, name: str) -> dict | None:
+        raise NotImplementedError
+
+    def list_resource(self, api_version: str, kind: str, namespace: str = "") -> list[dict]:
+        raise NotImplementedError
+
+    def create_resource(self, resource: dict) -> dict:
+        raise NotImplementedError
+
+    def update_resource(self, resource: dict) -> dict:
+        raise NotImplementedError
+
+    def delete_resource(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        raise NotImplementedError
+
+    def get_configmap(self, namespace: str, name: str) -> dict | None:
+        return self.get_resource("v1", "ConfigMap", namespace, name)
+
+
+def _meta(resource: dict) -> dict:
+    return resource.setdefault("metadata", {})
+
+
+class FakeCluster(Client):
+    """In-memory cluster: (kind, namespace, name) -> resource. Watch
+    callbacks fire on every write (the informer analogue)."""
+
+    def __init__(self, resources: list[dict] | None = None):
+        self._lock = threading.RLock()
+        self._store: dict[tuple[str, str, str], dict] = {}
+        self._watchers: list = []
+        self._rv = 0
+        for r in resources or []:
+            self.create_resource(r)
+
+    def _key(self, resource: dict) -> tuple[str, str, str]:
+        meta = resource.get("metadata") or {}
+        return (resource.get("kind", ""), meta.get("namespace", ""), meta.get("name", ""))
+
+    def get_resource(self, api_version, kind, namespace, name):
+        kind = _normalize_kind(kind)
+        with self._lock:
+            r = self._store.get((kind, namespace or "", name))
+            return copy.deepcopy(r) if r is not None else None
+
+    def list_resource(self, api_version, kind, namespace=""):
+        kind = _normalize_kind(kind)
+        with self._lock:
+            return [
+                copy.deepcopy(r)
+                for (k, ns, _), r in sorted(self._store.items())
+                if k == kind and (not namespace or ns == namespace)
+            ]
+
+    def create_resource(self, resource):
+        with self._lock:
+            resource = copy.deepcopy(resource)
+            self._rv += 1
+            _meta(resource)["resourceVersion"] = str(self._rv)
+            self._store[self._key(resource)] = resource
+            self._notify("ADDED", resource)
+            return copy.deepcopy(resource)
+
+    def update_resource(self, resource):
+        with self._lock:
+            resource = copy.deepcopy(resource)
+            self._rv += 1
+            _meta(resource)["resourceVersion"] = str(self._rv)
+            self._store[self._key(resource)] = resource
+            self._notify("MODIFIED", resource)
+            return copy.deepcopy(resource)
+
+    def delete_resource(self, api_version, kind, namespace, name):
+        kind = _normalize_kind(kind)
+        with self._lock:
+            r = self._store.pop((kind, namespace or "", name), None)
+            if r is not None:
+                self._notify("DELETED", r)
+
+    # informer-style change notification
+    def watch(self, callback) -> None:
+        with self._lock:
+            self._watchers.append(callback)
+
+    def _notify(self, event: str, resource: dict) -> None:
+        for cb in list(self._watchers):
+            try:
+                cb(event, copy.deepcopy(resource))
+            except Exception:
+                pass
+
+
+def _normalize_kind(kind: str) -> str:
+    # accept plural lowercase resource names from APICall urlPaths
+    if kind and kind[0].islower():
+        singular = kind[:-1] if kind.endswith("s") else kind
+        return singular[:1].upper() + singular[1:]
+    return kind
+
+
+# plural resource name -> Kind exceptions for the REST path builder
+_PLURAL_EXCEPTIONS = {
+    "endpoints": "Endpoints",
+    "networkpolicies": "NetworkPolicy",
+    "ingresses": "Ingress",
+}
+
+
+@dataclass
+class RestConfig:
+    server: str = "https://kubernetes.default.svc"
+    token: str = ""
+    ca_file: str = ""
+    insecure: bool = False
+
+    @classmethod
+    def in_cluster(cls) -> "RestConfig":
+        token = ""
+        try:
+            with open("/var/run/secrets/kubernetes.io/serviceaccount/token") as f:
+                token = f.read().strip()
+        except OSError:
+            pass
+        return cls(
+            token=token,
+            ca_file="/var/run/secrets/kubernetes.io/serviceaccount/ca.crt",
+        )
+
+
+class RestClient(Client):
+    """Minimal dynamic client over the K8s REST API (urllib; no kubectl)."""
+
+    def __init__(self, config: RestConfig, resource_map: dict[str, str] | None = None):
+        self.config = config
+        # Kind -> plural resource name
+        self.resource_map = resource_map or {}
+
+    def _plural(self, kind: str) -> str:
+        if kind in self.resource_map:
+            return self.resource_map[kind]
+        lower = kind.lower()
+        if lower.endswith("y"):
+            return lower[:-1] + "ies"
+        if lower.endswith("s"):
+            return lower + "es"
+        return lower + "s"
+
+    def _url(self, api_version: str, kind: str, namespace: str, name: str = "") -> str:
+        if "/" in api_version:
+            base = f"{self.config.server}/apis/{api_version}"
+        else:
+            base = f"{self.config.server}/api/{api_version or 'v1'}"
+        parts = [base]
+        if namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self._plural(kind))
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+    def _request(self, method: str, url: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        import ssl
+
+        ctx = ssl.create_default_context(
+            cafile=self.config.ca_file or None
+        )
+        if self.config.insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        with urllib.request.urlopen(req, context=ctx, timeout=15) as resp:
+            return json.loads(resp.read() or b"null")
+
+    def get_resource(self, api_version, kind, namespace, name):
+        try:
+            return self._request("GET", self._url(api_version, kind, namespace, name))
+        except Exception:
+            return None
+
+    def list_resource(self, api_version, kind, namespace=""):
+        try:
+            doc = self._request("GET", self._url(api_version, kind, namespace))
+            return list((doc or {}).get("items") or [])
+        except Exception:
+            return []
+
+    def create_resource(self, resource):
+        meta = resource.get("metadata") or {}
+        return self._request(
+            "POST",
+            self._url(resource.get("apiVersion", "v1"), resource.get("kind", ""),
+                      meta.get("namespace", "")),
+            resource,
+        )
+
+    def update_resource(self, resource):
+        meta = resource.get("metadata") or {}
+        return self._request(
+            "PUT",
+            self._url(resource.get("apiVersion", "v1"), resource.get("kind", ""),
+                      meta.get("namespace", ""), meta.get("name", "")),
+            resource,
+        )
+
+    def delete_resource(self, api_version, kind, namespace, name):
+        try:
+            self._request("DELETE", self._url(api_version, kind, namespace, name))
+        except Exception:
+            pass

@@ -1,0 +1,150 @@
+"""Prometheus-format metrics registry.
+
+Mirrors /root/reference/pkg/metrics/metrics.go:43-100 — the same six
+vectors with the same names — exposed in text format on /metrics
+(prometheus_client is not baked into the image, so the exposition is
+implemented directly; the format is the stable text/plain 0.0.4 protocol).
+A periodic reset clears the registry like PromConfig's cron (metrics.go:17).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+METRIC_NAMES = (
+    "kyverno_policy_results_total",
+    "kyverno_policy_rule_info_total",
+    "kyverno_policy_changes_total",
+    "kyverno_policy_execution_duration_seconds",
+    "kyverno_admission_review_duration_seconds",
+    "kyverno_admission_requests_total",
+)
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> frozenset(label items) -> value
+        self._counters: dict[str, dict[frozenset, float]] = {}
+        self._gauges: dict[str, dict[frozenset, float]] = {}
+        self._histograms: dict[str, dict[frozenset, list]] = {}
+        self._last_reset = time.time()
+
+    # ------------------------------------------------------------ writes
+
+    def inc_counter(self, name: str, labels: dict | None = None, value: float = 1.0) -> None:
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            key = frozenset((labels or {}).items())
+            series[key] = series.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, labels: dict | None = None, value: float = 0.0) -> None:
+        with self._lock:
+            self._gauges.setdefault(name, {})[frozenset((labels or {}).items())] = value
+
+    def observe(self, name: str, labels: dict | None = None, value: float = 0.0) -> None:
+        with self._lock:
+            series = self._histograms.setdefault(name, {})
+            key = frozenset((labels or {}).items())
+            bucket = series.setdefault(key, [0, 0.0])
+            bucket[0] += 1
+            bucket[1] += value
+
+    def reset(self) -> None:
+        """PromConfig periodic registry reset (metrics.go:17)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._last_reset = time.time()
+
+    # ------------------------------------------------------------ reads
+
+    @staticmethod
+    def _fmt_labels(key: frozenset) -> str:
+        if not key:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(key))
+        return "{" + inner + "}"
+
+    def expose(self) -> str:
+        """text/plain exposition."""
+        lines = []
+        with self._lock:
+            for name, series in sorted(self._counters.items()):
+                lines.append(f"# TYPE {name} counter")
+                for key, value in series.items():
+                    lines.append(f"{name}{self._fmt_labels(key)} {value:g}")
+            for name, series in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {name} gauge")
+                for key, value in series.items():
+                    lines.append(f"{name}{self._fmt_labels(key)} {value:g}")
+            for name, series in sorted(self._histograms.items()):
+                lines.append(f"# TYPE {name} summary")
+                for key, (count, total) in series.items():
+                    lines.append(f"{name}_count{self._fmt_labels(key)} {count:g}")
+                    lines.append(f"{name}_sum{self._fmt_labels(key)} {total:g}")
+        return "\n".join(lines) + "\n"
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+# ---------------------------------------------------------------- recorders
+# (the per-metric subpackages of pkg/metrics)
+
+
+def record_policy_results(registry: MetricsRegistry, policy: str, rule: str,
+                          status: str, policy_type: str = "cluster",
+                          validation_mode: str = "audit",
+                          resource_kind: str = "", request_operation: str = "CREATE") -> None:
+    registry.inc_counter("kyverno_policy_results_total", {
+        "policy_name": policy,
+        "rule_name": rule,
+        "rule_result": status,
+        "policy_type": policy_type,
+        "policy_validation_mode": validation_mode,
+        "resource_kind": resource_kind,
+        "resource_request_operation": request_operation,
+    })
+
+
+def record_policy_rule_info(registry: MetricsRegistry, policy: str, rule: str,
+                            rule_type: str, active: bool) -> None:
+    registry.set_gauge("kyverno_policy_rule_info_total", {
+        "policy_name": policy, "rule_name": rule, "rule_type": rule_type,
+    }, 1.0 if active else 0.0)
+
+
+def record_policy_change(registry: MetricsRegistry, policy: str, change: str) -> None:
+    registry.inc_counter("kyverno_policy_changes_total", {
+        "policy_name": policy, "policy_change_type": change,
+    })
+
+
+def record_policy_execution_duration(registry: MetricsRegistry, policy: str,
+                                     rule: str, seconds: float) -> None:
+    registry.observe("kyverno_policy_execution_duration_seconds", {
+        "policy_name": policy, "rule_name": rule,
+    }, seconds)
+
+
+def record_admission_review_duration(registry: MetricsRegistry, operation: str,
+                                     kind: str, seconds: float) -> None:
+    registry.observe("kyverno_admission_review_duration_seconds", {
+        "resource_request_operation": operation, "resource_kind": kind,
+    }, seconds)
+
+
+def record_admission_request(registry: MetricsRegistry, operation: str,
+                             kind: str, allowed: bool) -> None:
+    registry.inc_counter("kyverno_admission_requests_total", {
+        "resource_request_operation": operation,
+        "resource_kind": kind,
+        "request_allowed": str(allowed).lower(),
+    })

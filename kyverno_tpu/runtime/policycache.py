@@ -1,0 +1,157 @@
+"""Policy cache: O(1) kind -> policy-type -> policies admission lookup.
+
+Mirrors /root/reference/pkg/policycache (cache.go, type.go): a bitmask of
+policy types indexed per kind; namespaced Policy objects store as
+"namespace/name". Additionally — the TPU twist — the cache owns the
+compiled pattern tensors per (kind, type) population, rebuilt lazily on
+change: the "precompiled policy tensor at controller start" of the north
+star (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import threading
+from enum import IntFlag
+
+from ..api.types import ClusterPolicy
+
+
+class PolicyType(IntFlag):
+    """type.go:8-14."""
+
+    MUTATE = 1
+    VALIDATE_ENFORCE = 2
+    VALIDATE_AUDIT = 4
+    GENERATE = 8
+    VERIFY_IMAGES = 16
+
+
+def _title(kind: str) -> str:
+    return kind[:1].upper() + kind[1:] if kind else kind
+
+
+def _kind_from_gvk(gvk: str) -> str:
+    """common.GetKindFromGVK: 'apps/v1/Deployment' or 'Deployment'."""
+    return gvk.split("/")[-1]
+
+
+class PolicyCache:
+    """cache.go policyCache."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # kind -> PolicyType -> [policy keys]
+        self._kind_map: dict[str, dict[PolicyType, list[str]]] = {}
+        self._policies: dict[str, ClusterPolicy] = {}
+        self._compiled = {}
+        self._generation = 0
+
+    @staticmethod
+    def _key(policy: ClusterPolicy) -> str:
+        return f"{policy.namespace}/{policy.name}" if policy.namespace else policy.name
+
+    # ------------------------------------------------------------ writes
+
+    def add(self, policy: ClusterPolicy) -> None:
+        """cache.go:103 pMap.add."""
+        with self._lock:
+            key = self._key(policy)
+            if key in self._policies:
+                self._remove_locked(key)
+            self._policies[key] = policy
+            enforce = policy.spec.validation_failure_action == "enforce"
+            seen: set[tuple[str, PolicyType]] = set()
+            for rule in policy.spec.rules:
+                filters = rule.match.any or rule.match.all or [None]
+                for rf in filters:
+                    kinds = (
+                        rf.resources.kinds if rf is not None
+                        else rule.match.resources.kinds
+                    )
+                    for gvk in kinds:
+                        kind = _title(_kind_from_gvk(gvk))
+                        ptype = self._rule_type(rule, enforce)
+                        if ptype is None or (kind, ptype) in seen:
+                            continue
+                        seen.add((kind, ptype))
+                        self._kind_map.setdefault(kind, {}).setdefault(
+                            ptype, []
+                        ).append(key)
+            self._generation += 1
+            self._compiled.clear()
+
+    def remove(self, policy: ClusterPolicy) -> None:
+        with self._lock:
+            self._remove_locked(self._key(policy))
+            self._generation += 1
+            self._compiled.clear()
+
+    def update(self, policy: ClusterPolicy) -> None:
+        self.add(policy)
+
+    def _remove_locked(self, key: str) -> None:
+        self._policies.pop(key, None)
+        for type_map in self._kind_map.values():
+            for ptype in list(type_map):
+                type_map[ptype] = [k for k in type_map[ptype] if k != key]
+
+    @staticmethod
+    def _rule_type(rule, enforce: bool) -> PolicyType | None:
+        if rule.has_mutate():
+            return PolicyType.MUTATE
+        if rule.has_validate():
+            return PolicyType.VALIDATE_ENFORCE if enforce else PolicyType.VALIDATE_AUDIT
+        if rule.has_generate():
+            return PolicyType.GENERATE
+        if rule.has_verify_images():
+            return PolicyType.VERIFY_IMAGES
+        return None
+
+    # ------------------------------------------------------------ reads
+
+    def get_policies(self, ptype: PolicyType, kind: str, namespace: str = "") -> list[ClusterPolicy]:
+        """cache.go:89 GetPolicies: cluster policies + (if namespace given)
+        policies of that namespace; wildcard-kind policies always apply."""
+        with self._lock:
+            keys = list(self._get_keys(ptype, _title(kind)))
+            keys += [k for k in self._get_keys(ptype, "*") if k not in keys]
+            out = []
+            for key in keys:
+                policy = self._policies.get(key)
+                if policy is None:
+                    continue
+                if policy.namespace and policy.namespace != namespace:
+                    continue
+                out.append(policy)
+            return out
+
+    def _get_keys(self, ptype: PolicyType, kind: str) -> list[str]:
+        type_map = self._kind_map.get(kind, {})
+        out: list[str] = []
+        for t, keys in type_map.items():
+            if t & ptype:
+                out.extend(k for k in keys if k not in out)
+        return out
+
+    def all_policies(self) -> list[ClusterPolicy]:
+        with self._lock:
+            return list(self._policies.values())
+
+    # ------------------------------------------------------------ tensors
+
+    def compiled(self, ptype: PolicyType, kind: str, namespace: str = ""):
+        """The precompiled tensor set for an admission population; cached
+        until the policy set changes."""
+        from ..models import CompiledPolicySet
+
+        with self._lock:
+            cache_key = (int(ptype), _title(kind), namespace, self._generation)
+            cps = self._compiled.get(cache_key)
+            if cps is None:
+                policies = self.get_policies(ptype, kind, namespace)
+                cps = CompiledPolicySet(policies)
+                self._compiled = {cache_key: cps, **{
+                    k: v for k, v in self._compiled.items()
+                    if k[3] == self._generation
+                }}
+            return cps

@@ -1,0 +1,148 @@
+"""Policy report pipeline: engine results -> change requests -> reports.
+
+Mirrors /root/reference/pkg/policyreport's two-stage CQRS: (1) engine
+responses become ReportChangeRequest / ClusterReportChangeRequest documents
+(builder.go); (2) the ReportGenerator aggregates them per namespace into
+PolicyReport / ClusterPolicyReport (wgpolicyk8s.io/v1alpha2,
+reportcontroller.go:501 aggregateReports) and deletes consumed requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..engine.response import EngineResponse, RuleStatus
+
+_STATUS_TO_RESULT = {
+    RuleStatus.PASS: "pass",
+    RuleStatus.FAIL: "fail",
+    RuleStatus.WARN: "warn",
+    RuleStatus.ERROR: "error",
+    RuleStatus.SKIP: "skip",
+}
+
+
+def build_change_request(resp: EngineResponse) -> dict | None:
+    """builder.go: one change request per engine response; namespace-less
+    resources produce ClusterReportChangeRequests."""
+    pr = resp.policy_response
+    results = []
+    for rule in pr.rules:
+        results.append({
+            "policy": pr.policy.name,
+            "rule": rule.name,
+            "result": _STATUS_TO_RESULT[rule.status],
+            "message": rule.message,
+            "resources": [{
+                "kind": pr.resource.kind,
+                "apiVersion": pr.resource.api_version,
+                "namespace": pr.resource.namespace,
+                "name": pr.resource.name,
+                "uid": pr.resource.uid,
+            }],
+            "scored": True,
+            "timestamp": int(time.time()),
+        })
+    if not results:
+        return None
+    namespaced = bool(pr.resource.namespace)
+    return {
+        "apiVersion": "kyverno.io/v1alpha2",
+        "kind": "ReportChangeRequest" if namespaced else "ClusterReportChangeRequest",
+        "metadata": {
+            "name": f"rcr-{pr.policy.name}-{pr.resource.kind}-{pr.resource.name}".lower(),
+            "namespace": pr.resource.namespace,
+            "labels": {"kyverno.io/policy": pr.policy.name},
+        },
+        "results": results,
+    }
+
+
+def _summary(results: list[dict]) -> dict:
+    summary = {"pass": 0, "fail": 0, "warn": 0, "error": 0, "skip": 0}
+    for r in results:
+        summary[r.get("result", "skip")] = summary.get(r.get("result", "skip"), 0) + 1
+    return summary
+
+
+class ReportGenerator:
+    """reportcontroller.go ReportGenerator: collects change requests and
+    aggregates them into per-namespace PolicyReports + one
+    ClusterPolicyReport. ``reconcile`` rebuilds from scratch (the full
+    reconcile channel of cmd/kyverno/main.go:260)."""
+
+    def __init__(self, client=None):
+        self.client = client
+        self._lock = threading.Lock()
+        self._pending: list[dict] = []
+
+    def add(self, *responses: EngineResponse) -> None:
+        with self._lock:
+            for resp in responses:
+                rcr = build_change_request(resp)
+                if rcr is not None:
+                    self._pending.append(rcr)
+
+    def add_change_request(self, rcr: dict) -> None:
+        with self._lock:
+            self._pending.append(rcr)
+
+    def aggregate(self) -> list[dict]:
+        """reportcontroller.go:501 aggregateReports + :541 mergeRequests:
+        consume pending requests, emit the report objects."""
+        with self._lock:
+            pending = self._pending
+            self._pending = []
+
+        by_namespace: dict[str, list[dict]] = {}
+        for rcr in pending:
+            ns = (rcr.get("metadata") or {}).get("namespace", "")
+            by_namespace.setdefault(ns, []).extend(rcr.get("results") or [])
+
+        reports = []
+        for ns, results in sorted(by_namespace.items()):
+            # dedup: last write per (policy, rule, resource) wins
+            merged: dict[tuple, dict] = {}
+            for r in results:
+                res = (r.get("resources") or [{}])[0]
+                merged[(r.get("policy"), r.get("rule"),
+                        res.get("kind"), res.get("name"))] = r
+            results = list(merged.values())
+            if ns:
+                reports.append({
+                    "apiVersion": "wgpolicyk8s.io/v1alpha2",
+                    "kind": "PolicyReport",
+                    "metadata": {"name": f"polr-ns-{ns}", "namespace": ns},
+                    "results": results,
+                    "summary": _summary(results),
+                })
+            else:
+                reports.append({
+                    "apiVersion": "wgpolicyk8s.io/v1alpha2",
+                    "kind": "ClusterPolicyReport",
+                    "metadata": {"name": "clusterpolicyreport"},
+                    "results": results,
+                    "summary": _summary(results),
+                })
+        if self.client is not None:
+            for report in reports:
+                meta = report.get("metadata") or {}
+                existing = self.client.get_resource(
+                    report["apiVersion"], report["kind"],
+                    meta.get("namespace", ""), meta.get("name", ""),
+                )
+                if existing is None:
+                    self.client.create_resource(report)
+                else:
+                    # merge results into the stored report
+                    merged: dict[tuple, dict] = {}
+                    for r in (existing.get("results") or []) + report["results"]:
+                        res = (r.get("resources") or [{}])[0]
+                        merged[(r.get("policy"), r.get("rule"),
+                                res.get("kind"), res.get("name"))] = r
+                    existing["results"] = list(merged.values())
+                    existing["summary"] = _summary(existing["results"])
+                    self.client.update_resource(existing)
+        return reports

@@ -1,0 +1,424 @@
+"""Admission webhook server.
+
+Mirrors /root/reference/pkg/webhooks/server.go: an HTTPS server with POST
+routes /mutate, /validate, /policymutate, /policyvalidate plus liveness/
+readiness, a generic handler that parses the AdmissionReview, filters via
+dynamic config, dispatches, and marshals the response (server.go:244-276).
+Enforce validation failures block admission; audit runs async through the
+AuditHandler queue (validate_audit.go); matching generate policies produce
+GenerateRequest documents for the async controller.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import ssl
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..engine.context import Context, mutate_resource_with_image_info
+from ..engine.generation import generate as engine_generate
+from ..engine.mutation import mutate as engine_mutate
+from ..engine.policy_context import PolicyContext
+from ..engine.response import RuleStatus
+from ..engine.validation import validate as engine_validate
+from ..policy.autogen import apply_defaults, generate_pod_controller_rules
+from ..policy.validation import validate_policy
+from ..api.load import load_policy
+from . import metrics as metrics_mod
+from .config import ConfigData
+from .events import EventGenerator, events_for_engine_response
+from .policycache import PolicyCache, PolicyType
+from .reports import ReportGenerator
+from .userinfo import build_request_info
+from .workqueue import WorkerQueue
+
+# config.go:81-94 service paths
+MUTATING_WEBHOOK_PATH = "/mutate"
+VALIDATING_WEBHOOK_PATH = "/validate"
+POLICY_MUTATING_WEBHOOK_PATH = "/policymutate"
+POLICY_VALIDATING_WEBHOOK_PATH = "/policyvalidate"
+VERIFY_MUTATING_WEBHOOK_PATH = "/verifymutate"
+LIVENESS_PATH = "/health/liveness"
+READINESS_PATH = "/health/readiness"
+
+
+def _admission_response(uid: str, allowed: bool, message: str = "",
+                        patches: list | None = None) -> dict:
+    resp: dict = {"uid": uid, "allowed": allowed}
+    if message:
+        resp["status"] = {"message": message}
+    if patches:
+        resp["patchType"] = "JSONPatch"
+        resp["patch"] = base64.b64encode(json.dumps(patches).encode()).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+class AuditHandler(WorkerQueue):
+    """validate_audit.go:44 AuditHandler: a rate-limited queue re-running
+    audit validation off the hot path (10 workers, max 3 retries)."""
+
+    def __init__(self, handler, workers: int = 10):
+        super().__init__(handler, workers, name="audit", max_retries=3)
+
+
+class WebhookServer:
+    """server.go:135 NewWebhookServer (minus the cluster wiring)."""
+
+    def __init__(self, policy_cache: PolicyCache | None = None,
+                 config: ConfigData | None = None, client=None,
+                 event_gen: EventGenerator | None = None,
+                 report_gen: ReportGenerator | None = None,
+                 registry=None):
+        self.policy_cache = policy_cache or PolicyCache()
+        self.config = config or ConfigData()
+        self.client = client
+        self.event_gen = event_gen
+        self.report_gen = report_gen
+        self.registry = registry or metrics_mod.registry()
+        self.audit_handler = AuditHandler(self._process_audit)
+        self.last_request_time = time.time()
+        self._httpd: ThreadingHTTPServer | None = None
+
+    # ------------------------------------------------------------ dispatch
+
+    def handle(self, path: str, review: dict) -> dict:
+        """server.go:244 handlerFunc: the generic wrapper."""
+        start = time.monotonic()
+        self.last_request_time = time.time()
+        request = review.get("request") or {}
+        uid = request.get("uid", "")
+        kind = ((request.get("kind") or {}).get("kind")) or ""
+        namespace = request.get("namespace", "")
+        name = ((request.get("object") or {}).get("metadata") or {}).get("name", "")
+        operation = request.get("operation", "CREATE")
+
+        # dynamic config resource filters (server.go:252)
+        if path in (MUTATING_WEBHOOK_PATH, VALIDATING_WEBHOOK_PATH):
+            if self.config.to_filter(kind, namespace, name):
+                return _admission_response(uid, True)
+            username = ((request.get("userInfo") or {}).get("username")) or ""
+            if username and username in self.config.get_exclude_username():
+                return _admission_response(uid, True)
+
+        if path == MUTATING_WEBHOOK_PATH:
+            out = self._resource_mutation(request)
+        elif path == VALIDATING_WEBHOOK_PATH:
+            out = self._resource_validation(request)
+        elif path == POLICY_MUTATING_WEBHOOK_PATH:
+            out = self._policy_mutation(request)
+        elif path == POLICY_VALIDATING_WEBHOOK_PATH:
+            out = self._policy_validation(request)
+        elif path == VERIFY_MUTATING_WEBHOOK_PATH:
+            out = _admission_response(uid, True)  # monitor no-op probe
+        else:
+            return _admission_response(uid, False, f"unknown path {path}")
+
+        metrics_mod.record_admission_review_duration(
+            self.registry, operation, kind, time.monotonic() - start)
+        metrics_mod.record_admission_request(
+            self.registry, operation, kind, out["response"]["allowed"])
+        return out
+
+    # ------------------------------------------------------------ contexts
+
+    def _policy_context(self, request: dict, resource: dict) -> PolicyContext:
+        """server.go:343 buildPolicyContext + :638 newVariablesContext —
+        built ONCE per admission request and shared across the per-policy
+        loop (the engine checkpoints/restores the JSON context itself)."""
+        ctx = Context()
+        ctx.add_request(request)
+        if resource:
+            ctx.add_resource(resource)
+        if request.get("oldObject"):
+            ctx.add_old_resource(request["oldObject"])
+        user_info = request.get("userInfo") or {}
+        admission_info = build_request_info(self.client, user_info)
+        ctx.add_user_info({
+            "roles": admission_info.roles,
+            "clusterRoles": admission_info.cluster_roles,
+            "userInfo": user_info,
+        })
+        username = user_info.get("username", "")
+        if username:
+            ctx.add_service_account(username)
+        try:
+            ctx.add_image_info(resource)
+        except Exception:
+            pass
+        namespace_labels = {}
+        namespace = request.get("namespace", "")
+        if namespace and self.client is not None:
+            ns_obj = self.client.get_resource("v1", "Namespace", "", namespace)
+            if ns_obj:
+                namespace_labels = (ns_obj.get("metadata") or {}).get("labels") or {}
+        return PolicyContext(
+            new_resource=resource,
+            old_resource=request.get("oldObject") or {},
+            admission_info=admission_info,
+            exclude_group_role=self.config.get_exclude_group_role(),
+            client=self.client,
+            json_context=ctx,
+            namespace_labels=namespace_labels,
+        )
+
+    # ------------------------------------------------------------ handlers
+
+    def _resource_mutation(self, request: dict) -> dict:
+        """server.go:292 resourceMutation."""
+        uid = request.get("uid", "")
+        kind = ((request.get("kind") or {}).get("kind")) or ""
+        namespace = request.get("namespace", "")
+        resource = copy.deepcopy(request.get("object") or {})
+        policies = self.policy_cache.get_policies(PolicyType.MUTATE, kind, namespace)
+
+        patches: list = []
+        # canonicalize image references (server.go:318)
+        ctx_probe = Context()
+        try:
+            patched0, image_patches = mutate_resource_with_image_info(resource, ctx_probe)
+            if image_patches:
+                resource = patched0
+                patches.extend(image_patches)
+        except Exception:
+            pass
+
+        engine_responses = []
+        pctx = self._policy_context(request, resource)
+        for policy in policies:
+            pctx.policy = policy
+            pctx.new_resource = resource
+            resp = engine_mutate(pctx)
+            engine_responses.append(resp)
+            if resp.patched_resource is not None:
+                resource = resp.patched_resource
+            patches.extend(resp.patches)
+            for rule in resp.policy_response.rules:
+                metrics_mod.record_policy_results(
+                    self.registry, policy.name, rule.name, rule.status.value,
+                    resource_kind=kind,
+                    request_operation=request.get("operation", "CREATE"))
+
+        if self.event_gen is not None:
+            for resp in engine_responses:
+                self.event_gen.add(*events_for_engine_response(
+                    resp, self.config.generate_success_events()))
+        return _admission_response(uid, True, patches=patches)
+
+    def _resource_validation(self, request: dict) -> dict:
+        """server.go:476 resourceValidation: enforce inline, audit async,
+        then trigger generate policies."""
+        uid = request.get("uid", "")
+        kind = ((request.get("kind") or {}).get("kind")) or ""
+        namespace = request.get("namespace", "")
+        resource = request.get("object") or {}
+
+        enforce = self.policy_cache.get_policies(
+            PolicyType.VALIDATE_ENFORCE, kind, namespace)
+        blocked_msgs: list[str] = []
+        pctx = self._policy_context(request, resource)
+        for policy in enforce:
+            pctx.policy = policy
+            resp = engine_validate(pctx)
+            for rule in resp.policy_response.rules:
+                metrics_mod.record_policy_results(
+                    self.registry, policy.name, rule.name, rule.status.value,
+                    validation_mode="enforce", resource_kind=kind,
+                    request_operation=request.get("operation", "CREATE"))
+                if rule.status in (RuleStatus.FAIL, RuleStatus.ERROR):
+                    blocked_msgs.append(
+                        f"policy {policy.name}/{rule.name}: {rule.message}")
+            if self.event_gen is not None:
+                self.event_gen.add(*events_for_engine_response(resp))
+            if self.report_gen is not None:
+                self.report_gen.add(resp)
+
+        # a blocked request is returned BEFORE audit/generate side effects
+        # (server.go:553-563)
+        if blocked_msgs:
+            return _admission_response(
+                uid, False, "resource blocked due to policy violations:\n"
+                + "\n".join(blocked_msgs))
+
+        # async audit (server.go:559)
+        if self.policy_cache.get_policies(PolicyType.VALIDATE_AUDIT, kind, namespace):
+            self.audit_handler.add(request)
+
+        # generate policies -> GenerateRequest documents (server.go:562)
+        self._apply_generate_policies(request)
+        return _admission_response(uid, True)
+
+    def _process_audit(self, request: dict) -> None:
+        """validate_audit.go:151 process."""
+        kind = ((request.get("kind") or {}).get("kind")) or ""
+        namespace = request.get("namespace", "")
+        resource = request.get("object") or {}
+        pctx = self._policy_context(request, resource)
+        for policy in self.policy_cache.get_policies(
+            PolicyType.VALIDATE_AUDIT, kind, namespace
+        ):
+            pctx.policy = policy
+            resp = engine_validate(pctx)
+            for rule in resp.policy_response.rules:
+                metrics_mod.record_policy_results(
+                    self.registry, policy.name, rule.name, rule.status.value,
+                    validation_mode="audit", resource_kind=kind,
+                    request_operation=request.get("operation", "CREATE"))
+            if self.event_gen is not None:
+                self.event_gen.add(*events_for_engine_response(resp))
+            if self.report_gen is not None:
+                self.report_gen.add(resp)
+
+    def _apply_generate_policies(self, request: dict) -> None:
+        """webhooks/generation.go: matching generate rules become
+        GenerateRequest documents consumed by the generate controller."""
+        if self.client is None:
+            return
+        kind = ((request.get("kind") or {}).get("kind")) or ""
+        namespace = request.get("namespace", "")
+        resource = request.get("object") or {}
+        pctx = self._policy_context(request, resource)
+        for policy in self.policy_cache.get_policies(
+            PolicyType.GENERATE, kind, namespace
+        ):
+            pctx.policy = policy
+            resp = engine_generate(pctx)
+            applicable = [
+                r.name for r in resp.policy_response.rules
+                if r.status is RuleStatus.PASS
+            ]
+            if not applicable:
+                continue
+            meta = resource.get("metadata") or {}
+            self.client.create_resource({
+                "apiVersion": "kyverno.io/v1",
+                "kind": "GenerateRequest",
+                "metadata": {
+                    "name": f"gr-{uuid.uuid4().hex[:10]}",
+                    "namespace": "kyverno",
+                    "labels": {"generate.kyverno.io/policy-name": policy.name},
+                },
+                "spec": {
+                    "policy": policy.name,
+                    "resource": {
+                        "kind": resource.get("kind", ""),
+                        "apiVersion": resource.get("apiVersion", ""),
+                        "namespace": meta.get("namespace", ""),
+                        "name": meta.get("name", ""),
+                    },
+                    "context": {
+                        "userInfo": request.get("userInfo") or {},
+                        "admissionRequestInfo": {
+                            "operation": request.get("operation", "CREATE"),
+                        },
+                    },
+                },
+                "status": {"state": "Pending"},
+            })
+
+    def _policy_mutation(self, request: dict) -> dict:
+        """policymutation.go:17: defaults + autogen patches on the policy."""
+        uid = request.get("uid", "")
+        policy_doc = request.get("object") or {}
+        patches: list[dict] = []
+        spec = policy_doc.get("spec") or {}
+        if "validationFailureAction" not in spec:
+            patches.append({"op": "add", "path": "/spec/validationFailureAction",
+                            "value": "audit"})
+        if "background" not in spec:
+            patches.append({"op": "add", "path": "/spec/background", "value": True})
+        if "failurePolicy" not in spec:
+            patches.append({"op": "add", "path": "/spec/failurePolicy", "value": "Fail"})
+        defaulted = apply_defaults(policy_doc)
+        new_rules = generate_pod_controller_rules(defaulted)
+        base = len(spec.get("rules") or [])
+        for i, rule in enumerate(new_rules):
+            patches.append({"op": "add", "path": f"/spec/rules/{base + i}", "value": rule})
+        metrics_mod.record_policy_change(
+            self.registry, (policy_doc.get("metadata") or {}).get("name", ""),
+            request.get("operation", "CREATE").lower())
+        return _admission_response(uid, True, patches=patches)
+
+    def _policy_validation(self, request: dict) -> dict:
+        """policyvalidation.go: structural validation gates admission."""
+        uid = request.get("uid", "")
+        try:
+            policy = load_policy(request.get("object") or {})
+        except Exception as e:
+            return _admission_response(uid, False, f"invalid policy: {e}")
+        errors = validate_policy(policy)
+        if errors:
+            return _admission_response(uid, False, "; ".join(errors))
+        return _admission_response(uid, True)
+
+    # ------------------------------------------------------------ serving
+
+    def run(self, host: str = "0.0.0.0", port: int = 9443,
+            certfile: str = "", keyfile: str = "") -> ThreadingHTTPServer:
+        """server.go:568 RunAsync: serve in a daemon thread."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path in (LIVENESS_PATH, READINESS_PATH):
+                    self.send_response(200)
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                elif self.path == "/metrics":
+                    body = server.registry.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    out = server.handle(self.path, review)
+                    body = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+        httpd = ThreadingHTTPServer((host, port), Handler)
+        httpd.timeout = 15  # server.go:237 read/write timeouts
+        if certfile and keyfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        self.audit_handler.run()
+        if self.event_gen is not None:
+            self.event_gen.run()
+        self._httpd = httpd
+        return httpd
+
+    def stop(self) -> None:
+        """server.go:586 Stop."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+        self.audit_handler.stop()
+        if self.event_gen is not None:
+            self.event_gen.stop()

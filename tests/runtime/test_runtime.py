@@ -1,0 +1,305 @@
+"""Runtime integration: policy cache, webhook admission flow over HTTP,
+reports, events, background scan, generate controller."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kyverno_tpu.api.load import load_policies_from_path, load_policy
+from kyverno_tpu.runtime.background import BackgroundScanner
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.config import ConfigData, parse_kinds
+from kyverno_tpu.runtime.events import EventGenerator
+from kyverno_tpu.runtime.generate_controller import GR_COMPLETED, GenerateController
+from kyverno_tpu.runtime.metrics import MetricsRegistry
+from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+from kyverno_tpu.runtime.reports import ReportGenerator
+from kyverno_tpu.runtime.webhook import (
+    MUTATING_WEBHOOK_PATH,
+    POLICY_VALIDATING_WEBHOOK_PATH,
+    VALIDATING_WEBHOOK_PATH,
+    WebhookServer,
+)
+
+ENFORCE_POLICY = {
+    "apiVersion": "kyverno.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "message": "latest tag not allowed",
+                "pattern": {"spec": {"containers": [{"image": "!*:latest"}]}},
+            },
+        }],
+    },
+}
+
+MUTATE_POLICY = {
+    "apiVersion": "kyverno.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "add-labels"},
+    "spec": {"rules": [{
+        "name": "add-team-label",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "mutate": {"patchStrategicMerge": {"metadata": {"labels": {"+(team)": "platform"}}}},
+    }]},
+}
+
+GENERATE_POLICY = {
+    "apiVersion": "kyverno.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "add-networkpolicy"},
+    "spec": {"rules": [{
+        "name": "default-deny",
+        "match": {"resources": {"kinds": ["Namespace"]}},
+        "generate": {
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "name": "default-deny",
+            "namespace": "{{request.object.metadata.name}}",
+            "data": {"spec": {"podSelector": {}}},
+        },
+    }]},
+}
+
+
+def pod(name="p", image="nginx:latest", namespace="default"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": [{"name": "c", "image": image}]},
+    }
+
+
+def review(resource, operation="CREATE", namespace="default", uid="u1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "kind": {"kind": resource.get("kind", "")},
+            "namespace": namespace,
+            "operation": operation,
+            "object": resource,
+            "userInfo": {"username": "alice", "groups": ["system:authenticated"]},
+        },
+    }
+
+
+class TestPolicyCache:
+    def test_kind_index(self):
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE_POLICY))
+        cache.add(load_policy(MUTATE_POLICY))
+        assert [p.name for p in cache.get_policies(PolicyType.VALIDATE_ENFORCE, "Pod")] == [
+            "disallow-latest-tag"
+        ]
+        assert cache.get_policies(PolicyType.VALIDATE_AUDIT, "Pod") == []
+        assert [p.name for p in cache.get_policies(PolicyType.MUTATE, "Pod")] == ["add-labels"]
+        assert cache.get_policies(PolicyType.MUTATE, "Service") == []
+
+    def test_remove(self):
+        cache = PolicyCache()
+        policy = load_policy(MUTATE_POLICY)
+        cache.add(policy)
+        cache.remove(policy)
+        assert cache.get_policies(PolicyType.MUTATE, "Pod") == []
+
+    def test_namespaced_policy_scoped(self):
+        doc = dict(MUTATE_POLICY, kind="Policy")
+        doc["metadata"] = {"name": "ns-pol", "namespace": "team-a"}
+        cache = PolicyCache()
+        cache.add(load_policy(doc))
+        assert cache.get_policies(PolicyType.MUTATE, "Pod", "team-a")
+        assert cache.get_policies(PolicyType.MUTATE, "Pod", "team-b") == []
+
+
+class TestWebhookHandlers:
+    def make_server(self):
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE_POLICY))
+        cache.add(load_policy(MUTATE_POLICY))
+        cluster = FakeCluster()
+        return WebhookServer(
+            policy_cache=cache, config=ConfigData(), client=cluster,
+            event_gen=EventGenerator(cluster),
+            report_gen=ReportGenerator(), registry=MetricsRegistry(),
+        ), cluster
+
+    def test_enforce_blocks(self):
+        server, _ = self.make_server()
+        out = server.handle(VALIDATING_WEBHOOK_PATH, review(pod()))
+        assert out["response"]["allowed"] is False
+        assert "latest tag not allowed" in out["response"]["status"]["message"]
+
+    def test_enforce_allows_clean_pod(self):
+        server, _ = self.make_server()
+        out = server.handle(VALIDATING_WEBHOOK_PATH, review(pod(image="nginx:1.21")))
+        assert out["response"]["allowed"] is True
+
+    def test_mutation_patches(self):
+        import base64
+
+        server, _ = self.make_server()
+        out = server.handle(MUTATING_WEBHOOK_PATH, review(pod(image="nginx:1.21")))
+        assert out["response"]["allowed"] is True
+        patches = json.loads(base64.b64decode(out["response"]["patch"]))
+        assert any("team" in json.dumps(p) for p in patches)
+
+    def test_resource_filter_skips(self):
+        server, _ = self.make_server()
+        server.config.load({"resourceFilters": "[Pod,default,*]"})
+        out = server.handle(VALIDATING_WEBHOOK_PATH, review(pod()))
+        assert out["response"]["allowed"] is True  # filtered, not evaluated
+
+    def test_policy_validation_webhook(self):
+        server, _ = self.make_server()
+        bad = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "bad"},
+            "spec": {"rules": [{"name": "r", "match": {"resources": {"kinds": ["Pod"]}}}]},
+        }
+        out = server.handle(
+            POLICY_VALIDATING_WEBHOOK_PATH,
+            {"request": {"uid": "u", "object": bad, "operation": "CREATE"}},
+        )
+        assert out["response"]["allowed"] is False
+
+    def test_generate_request_created(self):
+        cache = PolicyCache()
+        cache.add(load_policy(GENERATE_POLICY))
+        cluster = FakeCluster()
+        server = WebhookServer(policy_cache=cache, client=cluster)
+        ns = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "team-a"}}
+        out = server.handle(VALIDATING_WEBHOOK_PATH, review(ns, namespace=""))
+        assert out["response"]["allowed"] is True
+        grs = cluster.list_resource("kyverno.io/v1", "GenerateRequest")
+        assert len(grs) == 1
+        assert grs[0]["spec"]["policy"] == "add-networkpolicy"
+
+    def test_metrics_recorded(self):
+        server, _ = self.make_server()
+        server.handle(VALIDATING_WEBHOOK_PATH, review(pod()))
+        text = server.registry.expose()
+        assert "kyverno_policy_results_total" in text
+        assert "kyverno_admission_requests_total" in text
+
+
+class TestWebhookHTTP:
+    def test_over_http(self):
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE_POLICY))
+        server = WebhookServer(policy_cache=cache)
+        httpd = server.run(host="127.0.0.1", port=0)
+        port = httpd.server_address[1]
+        try:
+            body = json.dumps(review(pod())).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{VALIDATING_WEBHOOK_PATH}",
+                data=body, headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is False
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health/liveness", timeout=5
+            ) as resp:
+                assert resp.status == 200
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as resp:
+                assert b"kyverno_admission_requests_total" in resp.read()
+        finally:
+            server.stop()
+
+
+class TestAuditAndReports:
+    def test_audit_path_feeds_reports(self):
+        audit_doc = dict(ENFORCE_POLICY)
+        audit_doc = json.loads(json.dumps(audit_doc))
+        audit_doc["spec"]["validationFailureAction"] = "audit"
+        cache = PolicyCache()
+        cache.add(load_policy(audit_doc))
+        reports = ReportGenerator()
+        server = WebhookServer(policy_cache=cache, report_gen=reports)
+        out = server.handle(VALIDATING_WEBHOOK_PATH, review(pod()))
+        assert out["response"]["allowed"] is True  # audit never blocks
+        server.audit_handler.run()
+        server.audit_handler.drain()
+        server.audit_handler.stop()
+        built = reports.aggregate()
+        assert len(built) == 1
+        assert built[0]["kind"] == "PolicyReport"
+        assert built[0]["summary"]["fail"] == 1
+
+
+class TestConfig:
+    def test_parse_kinds(self):
+        filters = parse_kinds("[Event][*,kube-system,*][Node,,]")
+        assert filters[0].kind == "Event"
+        assert filters[1].namespace == "kube-system"
+        cfg = ConfigData({"resourceFilters": "[Event][*,kube-system,*]"})
+        assert cfg.to_filter("Event", "default", "x")
+        assert cfg.to_filter("Pod", "kube-system", "x")
+        assert not cfg.to_filter("Pod", "default", "x")
+
+
+class TestBackgroundScan:
+    def test_scan_snapshot(self):
+        policies = load_policies_from_path("/root/reference/test/best_practices/")
+        cluster = FakeCluster([pod(f"p{i}", "nginx:latest" if i % 2 else "nginx:1")
+                               for i in range(10)])
+        reports = ReportGenerator()
+        scanner = BackgroundScanner(policies, client=cluster, report_gen=reports)
+        result = scanner.scan()
+        assert result.resources_scanned == 10
+        # half the pods use :latest; they also violate label/resource rules
+        latest_fails = sum(
+            1
+            for resp in result.responses
+            if resp.policy_response.policy.name == "disallow-latest-tag"
+            for rr in resp.policy_response.rules
+            if rr.name == "validate-image-tag" and rr.status.value == "fail"
+        )
+        assert latest_fails == 5
+        assert result.violations >= 5
+        built = reports.aggregate()
+        assert built and built[0]["summary"]["fail"] >= 5
+
+    def test_background_false_policies_excluded(self):
+        doc = json.loads(json.dumps(ENFORCE_POLICY))
+        doc["spec"]["background"] = False
+        scanner = BackgroundScanner([load_policy(doc)])
+        assert scanner.policies == []
+
+
+class TestGenerateController:
+    def test_process_generate_request(self):
+        policy = load_policy(GENERATE_POLICY)
+        ns = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "team-a"}}
+        cluster = FakeCluster([ns])
+        cache = PolicyCache()
+        cache.add(policy)
+        server = WebhookServer(policy_cache=cache, client=cluster)
+        server.handle(VALIDATING_WEBHOOK_PATH, review(ns, namespace=""))
+
+        controller = GenerateController(cluster, {policy.name: policy})
+        assert controller.sync_from_cluster() == 1
+        controller.run()
+        controller.drain()
+        controller.stop()
+
+        netpol = cluster.get_resource(
+            "networking.k8s.io/v1", "NetworkPolicy", "team-a", "default-deny")
+        assert netpol is not None
+        assert netpol["metadata"]["labels"]["kyverno.io/generated-by-policy"] == (
+            "add-networkpolicy"
+        )
+        grs = cluster.list_resource("kyverno.io/v1", "GenerateRequest")
+        assert grs[0]["status"]["state"] == GR_COMPLETED
