@@ -1,0 +1,104 @@
+"""Leader election over a Lease object.
+
+Mirrors /root/reference/pkg/leaderelection/leaderelection.go (client-go
+lease-based election; 15s lease / 10s renew deadline): replicas race to
+acquire/renew a coordination.k8s.io Lease through the client; the holder
+runs the leader-only controllers (background scan, generate controller,
+webhook registration), everyone serves webhooks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+LEASE_DURATION_S = 15.0
+RENEW_DEADLINE_S = 10.0
+RETRY_PERIOD_S = 2.0
+
+
+class LeaderElector:
+    def __init__(self, client, name: str = "kyverno", namespace: str = "kyverno",
+                 identity: str | None = None,
+                 on_started_leading=None, on_stopped_leading=None):
+        self.client = client
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{name}-{uuid.uuid4().hex[:8]}"
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def is_leader(self) -> bool:
+        return self._leading
+
+    def _lease(self) -> dict | None:
+        return self.client.get_resource(
+            "coordination.k8s.io/v1", "Lease", self.namespace, self.name)
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns current leadership."""
+        now = time.time()
+        lease = self._lease()
+        if lease is None:
+            self.client.create_resource({
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": {
+                    "holderIdentity": self.identity,
+                    "leaseDurationSeconds": int(LEASE_DURATION_S),
+                    "renewTime": now,
+                },
+            })
+            return self._transition(True)
+
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity", "")
+        renew_time = float(spec.get("renewTime") or 0)
+        expired = now - renew_time > LEASE_DURATION_S
+
+        if holder == self.identity or expired or not holder:
+            spec["holderIdentity"] = self.identity
+            spec["renewTime"] = now
+            lease["spec"] = spec
+            self.client.update_resource(lease)
+            return self._transition(True)
+        return self._transition(False)
+
+    def _transition(self, leading: bool) -> bool:
+        if leading and not self._leading:
+            self._leading = True
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif not leading and self._leading:
+            self._leading = False
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+        return self._leading
+
+    def run(self, retry_period_s: float = RETRY_PERIOD_S) -> None:
+        def loop():
+            while not self._stop.wait(retry_period_s):
+                try:
+                    self.try_acquire_or_renew()
+                except Exception:
+                    self._transition(False)
+
+        self.try_acquire_or_renew()
+        self._thread = threading.Thread(target=loop, name="leader-elector", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._leading:
+            lease = self._lease()
+            if lease is not None and (lease.get("spec") or {}).get(
+                "holderIdentity"
+            ) == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update_resource(lease)
+            self._transition(False)

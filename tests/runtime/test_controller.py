@@ -1,0 +1,145 @@
+"""Full-process wiring: leader election, webhook registration, monitor,
+init cleanup, end-to-end controller lifecycle against a FakeCluster."""
+
+import json
+import urllib.request
+
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.leaderelection import LeaderElector
+from kyverno_tpu.runtime.webhookconfig import (
+    IDLE_DEADLINE_S,
+    MUTATING_WEBHOOK_CONFIG,
+    Monitor,
+    Register,
+)
+from kyverno_tpu.server import Controller, init_cleanup
+
+ENFORCE_POLICY = {
+    "apiVersion": "kyverno.io/v1",
+    "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {
+                "message": "latest tag not allowed",
+                "pattern": {"spec": {"containers": [{"image": "!*:latest"}]}},
+            },
+        }],
+    },
+}
+
+
+class TestRegisterAndMonitor:
+    def test_register_check_remove(self):
+        cluster = FakeCluster()
+        register = Register(cluster)
+        assert register.check() is False
+        register.register()
+        assert register.check() is True
+        assert cluster.get_resource(
+            "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
+            "", MUTATING_WEBHOOK_CONFIG) is not None
+        register.remove()
+        assert register.check() is False
+
+    def test_monitor_re_registers_after_idle_deadline(self):
+        import time
+
+        cluster = FakeCluster()
+        register = Register(cluster)
+        register.register()
+        monitor = Monitor(register)
+        monitor.set_time(time.monotonic() - IDLE_DEADLINE_S - 1)
+        monitor.check_once()
+        assert monitor.re_registrations == 1
+        assert register.check() is True
+
+    def test_monitor_restores_deleted_webhooks(self):
+        cluster = FakeCluster()
+        register = Register(cluster)
+        register.register()
+        register.remove()
+        monitor = Monitor(register)
+        monitor.check_once()
+        assert register.check() is True
+
+
+class TestLeaderElection:
+    def test_single_leader(self):
+        cluster = FakeCluster()
+        a = LeaderElector(cluster, identity="a")
+        b = LeaderElector(cluster, identity="b")
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        assert a.is_leader() and not b.is_leader()
+
+    def test_failover_after_release(self):
+        cluster = FakeCluster()
+        a = LeaderElector(cluster, identity="a")
+        b = LeaderElector(cluster, identity="b")
+        a.try_acquire_or_renew()
+        a.stop()
+        assert b.try_acquire_or_renew() is True
+
+    def test_callbacks(self):
+        cluster = FakeCluster()
+        events = []
+        a = LeaderElector(cluster, identity="a",
+                          on_started_leading=lambda: events.append("start"))
+        a.try_acquire_or_renew()
+        assert events == ["start"]
+
+
+class TestControllerLifecycle:
+    def test_end_to_end(self):
+        cluster = FakeCluster([ENFORCE_POLICY])
+        controller = Controller(client=cluster, serve_port=0)
+        controller.start(host="127.0.0.1")
+        try:
+            assert controller.elector.is_leader()
+            # leader registered the webhooks
+            assert controller.register.check() is True
+
+            port = controller.webhook._httpd.server_address[1]
+            review = {
+                "request": {
+                    "uid": "u1",
+                    "kind": {"kind": "Pod"},
+                    "namespace": "default",
+                    "operation": "CREATE",
+                    "object": {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "p", "namespace": "default"},
+                        "spec": {"containers": [{"name": "c", "image": "nginx:latest"}]},
+                    },
+                    "userInfo": {"username": "alice"},
+                },
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/validate",
+                data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is False
+
+            scan = controller.run_background_scan()
+            assert scan.resources_scanned == 0  # no Pods stored in cluster
+        finally:
+            controller.stop()
+
+    def test_init_cleanup(self):
+        cluster = FakeCluster()
+        register = Register(cluster)
+        register.register()
+        cluster.create_resource({
+            "apiVersion": "kyverno.io/v1alpha2", "kind": "ReportChangeRequest",
+            "metadata": {"name": "stale", "namespace": "kyverno"},
+        })
+        init_cleanup(cluster)
+        assert register.check() is False
+        assert cluster.list_resource("kyverno.io/v1alpha2", "ReportChangeRequest") == []
