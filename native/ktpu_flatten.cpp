@@ -1,0 +1,616 @@
+// ktpu_flatten: resource JSON -> leaf slot tensors, the native twin of
+// kyverno_tpu/models/flatten.py (same layout, byte-for-byte).
+//
+// The reference engine has no native code (SURVEY.md header); this library
+// is the new host-side component the north star calls for: admission
+// payloads arrive as JSON bytes, and turning them into device tensors is
+// the end-to-end bottleneck of the TPU path (bench.py flatten_s). It
+// parses JSON directly (no Python dict intermediary), enumerates the
+// compiled path dictionary against each document, interns the string
+// dictionary, and decomposes numbers/quantities into exact i64 micro-units
+// -- mirroring models/flatten.py semantics including phantom slots,
+// prefix-presence masks, host-lane flags, and Go-style float
+// stringification (utils/gofmt.py).
+//
+// C ABI only (consumed via ctypes; pybind11 is not in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <charconv>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr char SEP = '\x1f';
+constexpr int64_t NUM_SCALE_POW10 = 6;          // micro-units
+constexpr int64_t NUM_MAX = int64_t(1) << 62;
+
+// type tags (models/flatten.py)
+enum : int8_t { T_ABSENT = 0, T_NULL, T_BOOL, T_NUM, T_STR, T_OBJ, T_LIST };
+
+// ------------------------------------------------------------------ JSON
+
+struct Value {
+    enum Type : uint8_t { Null, Bool, Num, Str, Obj, Arr } t = Null;
+    bool b = false;
+    std::string_view raw;                       // Num: literal token text
+    std::string str;                            // Str: decoded text
+    std::vector<std::pair<std::string, Value*>> obj;
+    std::vector<Value*> arr;
+};
+
+struct Parser {
+    const char* p;
+    const char* end;
+    std::deque<Value>* arena;
+    bool ok = true;
+
+    Value* alloc() { arena->emplace_back(); return &arena->back(); }
+
+    void skip_ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+    }
+
+    bool lit(const char* s, size_t n) {
+        if (size_t(end - p) < n || memcmp(p, s, n) != 0) return false;
+        p += n;
+        return true;
+    }
+
+    Value* parse() {
+        skip_ws();
+        if (p >= end) { ok = false; return nullptr; }
+        switch (*p) {
+            case '{': return parse_obj();
+            case '[': return parse_arr();
+            case '"': return parse_str();
+            case 't': { Value* v = alloc(); v->t = Value::Bool; v->b = true;
+                        if (!lit("true", 4)) ok = false; return v; }
+            case 'f': { Value* v = alloc(); v->t = Value::Bool; v->b = false;
+                        if (!lit("false", 5)) ok = false; return v; }
+            case 'n': { Value* v = alloc(); v->t = Value::Null;
+                        if (!lit("null", 4)) ok = false; return v; }
+            default:  return parse_num();
+        }
+    }
+
+    Value* parse_obj() {
+        Value* v = alloc(); v->t = Value::Obj;
+        ++p;  // '{'
+        skip_ws();
+        if (p < end && *p == '}') { ++p; return v; }
+        while (p < end) {
+            skip_ws();
+            if (p >= end || *p != '"') { ok = false; return v; }
+            Value* key = parse_str();
+            skip_ws();
+            if (p >= end || *p != ':') { ok = false; return v; }
+            ++p;
+            Value* val = parse();
+            if (!ok) return v;
+            v->obj.emplace_back(std::move(key->str), val);
+            skip_ws();
+            if (p < end && *p == ',') { ++p; continue; }
+            if (p < end && *p == '}') { ++p; return v; }
+            ok = false; return v;
+        }
+        ok = false; return v;
+    }
+
+    Value* parse_arr() {
+        Value* v = alloc(); v->t = Value::Arr;
+        ++p;  // '['
+        skip_ws();
+        if (p < end && *p == ']') { ++p; return v; }
+        while (p < end) {
+            Value* el = parse();
+            if (!ok) return v;
+            v->arr.push_back(el);
+            skip_ws();
+            if (p < end && *p == ',') { ++p; continue; }
+            if (p < end && *p == ']') { ++p; return v; }
+            ok = false; return v;
+        }
+        ok = false; return v;
+    }
+
+    Value* parse_str() {
+        Value* v = alloc(); v->t = Value::Str;
+        ++p;  // '"'
+        std::string& out = v->str;
+        while (p < end && *p != '"') {
+            if (*p == '\\') {
+                ++p;
+                if (p >= end) { ok = false; return v; }
+                switch (*p) {
+                    case '"': out += '"'; break;
+                    case '\\': out += '\\'; break;
+                    case '/': out += '/'; break;
+                    case 'b': out += '\b'; break;
+                    case 'f': out += '\f'; break;
+                    case 'n': out += '\n'; break;
+                    case 'r': out += '\r'; break;
+                    case 't': out += '\t'; break;
+                    case 'u': {
+                        if (end - p < 5) { ok = false; return v; }
+                        unsigned cp = 0;
+                        for (int i = 1; i <= 4; ++i) {
+                            char c = p[i];
+                            cp <<= 4;
+                            if (c >= '0' && c <= '9') cp |= unsigned(c - '0');
+                            else if (c >= 'a' && c <= 'f') cp |= unsigned(c - 'a' + 10);
+                            else if (c >= 'A' && c <= 'F') cp |= unsigned(c - 'A' + 10);
+                            else { ok = false; return v; }
+                        }
+                        p += 4;
+                        // surrogate pairs
+                        if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 7 &&
+                            p[1] == '\\' && p[2] == 'u') {
+                            unsigned lo = 0;
+                            bool lo_ok = true;
+                            for (int i = 3; i <= 6; ++i) {
+                                char c = p[i];
+                                lo <<= 4;
+                                if (c >= '0' && c <= '9') lo |= unsigned(c - '0');
+                                else if (c >= 'a' && c <= 'f') lo |= unsigned(c - 'a' + 10);
+                                else if (c >= 'A' && c <= 'F') lo |= unsigned(c - 'A' + 10);
+                                else { lo_ok = false; break; }
+                            }
+                            if (lo_ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                                p += 6;
+                            }
+                        }
+                        // utf-8 encode
+                        if (cp < 0x80) out += char(cp);
+                        else if (cp < 0x800) {
+                            out += char(0xC0 | (cp >> 6));
+                            out += char(0x80 | (cp & 0x3F));
+                        } else if (cp < 0x10000) {
+                            out += char(0xE0 | (cp >> 12));
+                            out += char(0x80 | ((cp >> 6) & 0x3F));
+                            out += char(0x80 | (cp & 0x3F));
+                        } else {
+                            out += char(0xF0 | (cp >> 18));
+                            out += char(0x80 | ((cp >> 12) & 0x3F));
+                            out += char(0x80 | ((cp >> 6) & 0x3F));
+                            out += char(0x80 | (cp & 0x3F));
+                        }
+                        break;
+                    }
+                    default: ok = false; return v;
+                }
+                ++p;
+            } else {
+                out += *p++;
+            }
+        }
+        if (p >= end) { ok = false; return v; }
+        ++p;  // closing '"'
+        return v;
+    }
+
+    Value* parse_num() {
+        Value* v = alloc(); v->t = Value::Num;
+        const char* start = p;
+        if (p < end && (*p == '-' || *p == '+')) ++p;
+        while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                           *p == 'E' || *p == '+' || *p == '-')) ++p;
+        if (p == start) { ok = false; return v; }
+        v->raw = std::string_view(start, size_t(p - start));
+        return v;
+    }
+};
+
+const Value* obj_get(const Value* v, std::string_view key) {
+    if (v == nullptr || v->t != Value::Obj) return nullptr;
+    for (const auto& kv : v->obj)
+        if (kv.first == key) return kv.second;
+    return nullptr;
+}
+
+// ------------------------------------------------------------ quantities
+
+// Exact micro-unit decomposition of a quantity token (utils/quantity.py +
+// models/ir.py quantity_to_micro). Returns false when not a quantity or
+// not exactly representable.
+bool quantity_to_micro(std::string_view s, int64_t* out) {
+    // trim
+    while (!s.empty() && (s.front() == ' ' || s.front() == '\t')) s.remove_prefix(1);
+    while (!s.empty() && (s.back() == ' ' || s.back() == '\t')) s.remove_suffix(1);
+    if (s.empty()) return false;
+
+    size_t i = 0;
+    bool neg = false;
+    if (s[i] == '+' || s[i] == '-') { neg = s[i] == '-'; ++i; }
+
+    __int128 digits = 0;
+    int n_int = 0, n_frac = 0;
+    bool seen_dot = false;
+    int total_digits = 0;
+    for (; i < s.size(); ++i) {
+        char c = s[i];
+        if (c >= '0' && c <= '9') {
+            if (++total_digits > 36) return false;  // beyond exact range
+            digits = digits * 10 + (c - '0');
+            if (seen_dot) ++n_frac; else ++n_int;
+        } else if (c == '.' && !seen_dot) {
+            seen_dot = true;
+        } else {
+            break;
+        }
+    }
+    if (n_int == 0 && n_frac == 0) return false;
+
+    std::string_view suffix = s.substr(i);
+    int pow10 = 0;
+    int pow2 = 0;
+    if (!suffix.empty()) {
+        if (suffix == "Ki") pow2 = 10;
+        else if (suffix == "Mi") pow2 = 20;
+        else if (suffix == "Gi") pow2 = 30;
+        else if (suffix == "Ti") pow2 = 40;
+        else if (suffix == "Pi") pow2 = 50;
+        else if (suffix == "Ei") pow2 = 60;
+        else if (suffix == "n") pow10 = -9;
+        else if (suffix == "u") pow10 = -6;
+        else if (suffix == "m") pow10 = -3;
+        else if (suffix == "k") pow10 = 3;
+        else if (suffix == "M") pow10 = 6;
+        else if (suffix == "G") pow10 = 9;
+        else if (suffix == "T") pow10 = 12;
+        else if (suffix == "P") pow10 = 15;
+        else if (suffix == "E") pow10 = 18;
+        else if (suffix[0] == 'e' || suffix[0] == 'E') {
+            int exp = 0;
+            bool eneg = false;
+            size_t j = 1;
+            if (j < suffix.size() && (suffix[j] == '+' || suffix[j] == '-')) {
+                eneg = suffix[j] == '-';
+                ++j;
+            }
+            if (j >= suffix.size()) return false;
+            for (; j < suffix.size(); ++j) {
+                if (suffix[j] < '0' || suffix[j] > '9') return false;
+                exp = exp * 10 + (suffix[j] - '0');
+                if (exp > 40) return false;
+            }
+            pow10 = eneg ? -exp : exp;
+        } else {
+            return false;
+        }
+    }
+
+    // value = digits * 10^(-n_frac) * 2^pow2 * 10^pow10; micro = value*10^6
+    __int128 num = digits;
+    for (int k = 0; k < pow2; ++k) {
+        num <<= 1;
+        if (num > (__int128(1) << 100)) return false;
+    }
+    int scale = -n_frac + pow10 + int(NUM_SCALE_POW10);
+    while (scale > 0) {
+        num *= 10;
+        --scale;
+        if (num > (__int128(1) << 110)) return false;
+    }
+    while (scale < 0) {
+        if (num % 10 != 0) return false;  // sub-micro precision
+        num /= 10;
+        ++scale;
+    }
+    if (num > __int128(NUM_MAX)) return false;
+    *out = neg ? -int64_t(num) : int64_t(num);
+    return true;
+}
+
+// Go strconv.FormatFloat(v,'E',-1,64) — shortest mantissa, E+NN exponent
+// (utils/gofmt.py format_float_sci).
+std::string format_float_sci(double v) {
+    if (v != v) return "NaN";
+    if (v == __builtin_inf()) return "+Inf";
+    if (v == -__builtin_inf()) return "-Inf";
+    char buf[64];
+    auto res = std::to_chars(buf, buf + sizeof buf, v);  // shortest repr
+    std::string shortest(buf, res.ptr);
+
+    bool neg = false;
+    std::string digits = shortest;
+    if (!digits.empty() && digits[0] == '-') { neg = true; digits.erase(0, 1); }
+
+    std::string mant_digits;
+    int iexp = 0;
+    auto epos = digits.find_first_of("eE");
+    if (epos != std::string::npos) {
+        std::string m = digits.substr(0, epos);
+        iexp = atoi(digits.c_str() + epos + 1);
+        auto dot = m.find('.');
+        if (dot != std::string::npos) m.erase(dot, 1);
+        while (m.size() > 1 && m.back() == '0') m.pop_back();
+        mant_digits = m;
+    } else {
+        auto dot = digits.find('.');
+        std::string int_part = dot == std::string::npos ? digits : digits.substr(0, dot);
+        std::string frac = dot == std::string::npos ? "" : digits.substr(dot + 1);
+        if (frac == "0") frac = "";
+        while (!frac.empty() && frac.back() == '0') frac.pop_back();
+        if (int_part == "0") {
+            size_t nz = frac.find_first_not_of('0');
+            if (nz == std::string::npos) return neg ? "-0E+00" : "0E+00";
+            iexp = -int(nz) - 1;
+            mant_digits = frac.substr(nz);
+        } else {
+            iexp = int(int_part.size()) - 1;
+            mant_digits = int_part + frac;
+            while (mant_digits.size() > 1 && mant_digits.back() == '0')
+                mant_digits.pop_back();
+        }
+    }
+    std::string out;
+    if (neg) out += '-';
+    out += mant_digits[0];
+    if (mant_digits.size() > 1) {
+        out += '.';
+        out += mant_digits.substr(1);
+    }
+    out += 'E';
+    out += iexp >= 0 ? '+' : '-';
+    int a = iexp >= 0 ? iexp : -iexp;
+    char eb[8];
+    snprintf(eb, sizeof eb, "%02d", a);
+    out += eb;
+    return out;
+}
+
+// value_to_string_for_equality for a Num token: ints keep their text,
+// floats format the Go way.
+bool num_token_is_int(std::string_view raw) {
+    for (char c : raw)
+        if (c == '.' || c == 'e' || c == 'E') return false;
+    return true;
+}
+
+// ------------------------------------------------------------------ ctx
+
+struct Ctx {
+    std::vector<std::vector<std::string>> paths;   // split segments
+    std::unordered_map<std::string, int32_t> kinds;
+    int str_len_cap = 64;
+};
+
+struct Interner {
+    std::unordered_map<std::string, int32_t> index;
+    std::vector<std::string> strings;
+
+    int32_t intern(const std::string& s) {
+        auto it = index.find(s);
+        if (it != index.end()) return it->second;
+        int32_t id = int32_t(strings.size());
+        index.emplace(s, id);
+        strings.push_back(s);
+        return id;
+    }
+};
+
+struct Outputs {
+    uint16_t* mask;
+    uint8_t* slot_valid;
+    int8_t* type_tag;
+    int32_t* str_id;
+    int64_t* num_val;
+    uint8_t* num_ok;
+    uint8_t* bool_val;
+    int32_t* elem0;
+    int32_t* kind_id;
+    uint8_t* host_flag;
+    int P, E;
+};
+
+struct Slot {
+    uint16_t mask;
+    int32_t elem0;
+    const Value* leaf;   // nullptr => phantom
+};
+
+void enumerate_slots(const Value* node, const std::vector<std::string>& segs,
+                     size_t i, uint16_t mask, int32_t elem0,
+                     std::vector<Slot>& out, int cap) {
+    if (int(out.size()) > cap) return;  // overflow checked by caller
+    if (i == segs.size()) {
+        out.push_back({mask, elem0, node});
+        return;
+    }
+    const std::string& seg = segs[i];
+    if (seg == "*") {
+        if (node == nullptr || node->t != Value::Arr) {
+            out.push_back({mask, elem0, nullptr});
+            return;
+        }
+        int32_t idx = 0;
+        for (const Value* el : node->arr) {
+            enumerate_slots(el, segs, i + 1, uint16_t(mask | (1u << (i + 1))),
+                            elem0 < 0 ? idx : elem0, out, cap);
+            ++idx;
+        }
+    } else {
+        const Value* child = obj_get(node, seg);
+        if (child == nullptr) {
+            out.push_back({mask, elem0, nullptr});
+            return;
+        }
+        enumerate_slots(child, segs, i + 1, uint16_t(mask | (1u << (i + 1))),
+                        elem0, out, cap);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// paths: '\n'-joined SEP-separated generalized paths
+// kinds: '\n'-joined kind names (index == id, matching tensors.kind_index)
+void* ktpu_create(const char* paths, const char* kinds, int str_len_cap) {
+    auto* ctx = new Ctx;
+    ctx->str_len_cap = str_len_cap;
+    std::string_view pv(paths ? paths : "");
+    size_t start = 0;
+    while (start <= pv.size() && !pv.empty()) {
+        size_t nl = pv.find('\n', start);
+        std::string_view line = pv.substr(
+            start, nl == std::string_view::npos ? pv.size() - start : nl - start);
+        if (!line.empty()) {
+            std::vector<std::string> segs;
+            size_t s0 = 0;
+            while (true) {
+                size_t sp = line.find(SEP, s0);
+                if (sp == std::string_view::npos) {
+                    segs.emplace_back(line.substr(s0));
+                    break;
+                }
+                segs.emplace_back(line.substr(s0, sp - s0));
+                s0 = sp + 1;
+            }
+            ctx->paths.push_back(std::move(segs));
+        }
+        if (nl == std::string_view::npos) break;
+        start = nl + 1;
+    }
+    std::string_view kv(kinds ? kinds : "");
+    start = 0;
+    int32_t kid = 0;
+    while (start <= kv.size() && !kv.empty()) {
+        size_t nl = kv.find('\n', start);
+        std::string_view line = kv.substr(
+            start, nl == std::string_view::npos ? kv.size() - start : nl - start);
+        if (!line.empty()) ctx->kinds.emplace(std::string(line), kid++);
+        if (nl == std::string_view::npos) break;
+        start = nl + 1;
+    }
+    return ctx;
+}
+
+void ktpu_destroy(void* handle) { delete static_cast<Ctx*>(handle); }
+
+// Flatten a batch. Arrays are laid out [B, P, E] row-major with E =
+// max_slots; returns the maximum slot count actually used (<= max_slots),
+// or -1 when the string dictionary capacity was exceeded (caller retries
+// with a larger str_cap). Documents that fail to parse set host_flag.
+int ktpu_flatten_batch(
+    void* handle, const char* const* docs, const int32_t* doc_lens, int n_docs,
+    int max_slots,
+    uint16_t* mask, uint8_t* slot_valid, int8_t* type_tag, int32_t* str_id,
+    int64_t* num_val, uint8_t* num_ok, uint8_t* bool_val, int32_t* elem0,
+    int32_t* kind_id, uint8_t* host_flag,
+    uint8_t* str_bytes, int32_t* str_lens, int32_t* n_strings, int str_cap) {
+
+    Ctx* ctx = static_cast<Ctx*>(handle);
+    const int P = int(ctx->paths.size());
+    const int E = max_slots;
+    const int L = ctx->str_len_cap;
+    Interner interner;
+    int e_used = 1;
+
+    for (int b = 0; b < n_docs; ++b) {
+        std::deque<Value> arena;
+        Parser parser{docs[b], docs[b] + doc_lens[b], &arena};
+        Value* root = parser.parse();
+        kind_id[b] = -1;
+        if (!parser.ok || root == nullptr) {
+            host_flag[b] = 1;
+            continue;
+        }
+        const Value* kind_v = obj_get(root, "kind");
+        if (kind_v != nullptr && kind_v->t == Value::Str) {
+            auto it = ctx->kinds.find(kind_v->str);
+            if (it != ctx->kinds.end()) kind_id[b] = it->second;
+        }
+
+        std::vector<Slot> slots;
+        for (int p = 0; p < P; ++p) {
+            slots.clear();
+            enumerate_slots(root, ctx->paths[p], 0, 1, -1, slots, max_slots);
+            if (int(slots.size()) > max_slots) {
+                host_flag[b] = 1;
+                slots.resize(size_t(max_slots));
+            }
+            if (int(slots.size()) > e_used) e_used = int(slots.size());
+
+            for (int e = 0; e < int(slots.size()); ++e) {
+                const size_t o = (size_t(b) * P + p) * E + size_t(e);
+                const Slot& slot = slots[size_t(e)];
+                mask[o] = slot.mask;
+                slot_valid[o] = 1;
+                elem0[o] = slot.elem0;
+                const Value* v = slot.leaf;
+                if (v == nullptr) continue;  // phantom: T_ABSENT default
+                switch (v->t) {
+                    case Value::Null:
+                        type_tag[o] = T_NULL;
+                        break;
+                    case Value::Bool: {
+                        type_tag[o] = T_BOOL;
+                        bool_val[o] = v->b ? 1 : 0;
+                        str_id[o] = interner.intern(v->b ? "true" : "false");
+                        break;
+                    }
+                    case Value::Num: {
+                        type_tag[o] = T_NUM;
+                        std::string text;
+                        if (num_token_is_int(v->raw)) {
+                            text = std::string(v->raw);
+                            if (!text.empty() && text[0] == '+') text.erase(0, 1);
+                        } else {
+                            text = format_float_sci(strtod(
+                                std::string(v->raw).c_str(), nullptr));
+                        }
+                        if (int(text.size()) <= L) str_id[o] = interner.intern(text);
+                        int64_t micro;
+                        if (quantity_to_micro(v->raw, &micro)) {
+                            num_val[o] = micro;
+                            num_ok[o] = 1;
+                        } else {
+                            host_flag[b] = 1;
+                        }
+                        break;
+                    }
+                    case Value::Str: {
+                        type_tag[o] = T_STR;
+                        if (int(v->str.size()) <= L) str_id[o] = interner.intern(v->str);
+                        else host_flag[b] = 1;
+                        int64_t micro;
+                        if (quantity_to_micro(v->str, &micro)) {
+                            num_val[o] = micro;
+                            num_ok[o] = 1;
+                        }
+                        break;
+                    }
+                    case Value::Obj:
+                        type_tag[o] = T_OBJ;
+                        break;
+                    case Value::Arr:
+                        type_tag[o] = T_LIST;
+                        break;
+                }
+            }
+        }
+    }
+
+    const int V = int(interner.strings.size());
+    if (V > str_cap) return -1;
+    const int L = ctx->str_len_cap;
+    for (int v = 0; v < V; ++v) {
+        const std::string& s = interner.strings[size_t(v)];
+        int len = int(s.size()) < L ? int(s.size()) : L;
+        memcpy(str_bytes + size_t(v) * L, s.data(), size_t(len));
+        str_lens[v] = len;
+    }
+    *n_strings = V < 1 ? 1 : V;
+    return e_used;
+}
+
+}  // extern "C"
