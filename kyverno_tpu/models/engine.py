@@ -93,6 +93,14 @@ class CompiledPolicySet:
         """Verdict matrix [B, R]: device lane + CPU oracle for HOST cells."""
         batch = self.flatten(resources)
         verdicts = self.evaluate_device(batch)
+        return self.resolve_host_cells(resources, verdicts)
+
+    def resolve_host_cells(self, resources: list[dict],
+                           verdicts: np.ndarray) -> np.ndarray:
+        """Replace Verdict.HOST cells with CPU-oracle verdicts, in place.
+
+        Shared by the single-chip path and the mesh path (parallel/mesh.py
+        sharded_scan) so host-lane rules are never silently dropped."""
         host_cells = np.argwhere(verdicts == Verdict.HOST)
         if host_cells.size:
             by_resource: dict[int, list[int]] = {}

@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.engine import CompiledPolicySet
 from ..models.flatten import FlatBatch
-from ..ops.eval import V_FAIL, V_PASS
+from ..ops.eval import V_FAIL, V_HOST, V_PASS
 
 
 def make_mesh(devices=None, axis: str = "data") -> Mesh:
@@ -93,11 +93,19 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
 
     Returns (verdicts [B, R] numpy, fails [R], passes [R]) — the mesh-scale
     replay of /root/reference/pkg/policy/existing.go:20
-    processExistingResources.
+    processExistingResources. Host-lane cells (Verdict.HOST) are resolved
+    through the CPU oracle exactly like CompiledPolicySet.evaluate, and the
+    pass/fail counts are recomputed over the resolved matrix so
+    precondition/context rules are reported, not dropped.
     """
     batch = cps.flatten(resources)
     batch, n = pad_batch(batch, mesh.devices.size)
     fn = sharded_eval_fn(cps, mesh, axis)
     verdict, fails, passes = fn(*_batch_arrays(batch), batch.str_bytes,
                                 batch.str_len)
-    return np.array(verdict)[:n], np.array(fails), np.array(passes)
+    verdicts = np.array(verdict)[:n]
+    if (verdicts == V_HOST).any():
+        verdicts = cps.resolve_host_cells(resources, verdicts)
+        fails = (verdicts == V_FAIL).sum(axis=0)
+        passes = (verdicts == V_PASS).sum(axis=0)
+    return verdicts, np.array(fails), np.array(passes)

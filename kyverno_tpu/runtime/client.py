@@ -15,8 +15,14 @@ from __future__ import annotations
 import copy
 import json
 import threading
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
+
+
+class ConflictError(Exception):
+    """Optimistic-concurrency failure: stale resourceVersion on update
+    (HTTP 409) or create of an existing object (AlreadyExists)."""
 
 
 class Client:
@@ -78,19 +84,30 @@ class FakeCluster(Client):
 
     def create_resource(self, resource):
         with self._lock:
+            key = self._key(resource)
+            if key in self._store:
+                raise ConflictError(f"AlreadyExists: {key}")
             resource = copy.deepcopy(resource)
             self._rv += 1
             _meta(resource)["resourceVersion"] = str(self._rv)
-            self._store[self._key(resource)] = resource
+            self._store[key] = resource
             self._notify("ADDED", resource)
             return copy.deepcopy(resource)
 
     def update_resource(self, resource):
+        """Resource-version-guarded update, like the real API server: a PUT
+        carrying a stale metadata.resourceVersion returns 409 Conflict."""
         with self._lock:
+            key = self._key(resource)
+            stored = self._store.get(key)
+            sent_rv = (resource.get("metadata") or {}).get("resourceVersion")
+            if stored is not None and sent_rv is not None:
+                if stored["metadata"].get("resourceVersion") != sent_rv:
+                    raise ConflictError(f"Conflict: {key} rv={sent_rv}")
             resource = copy.deepcopy(resource)
             self._rv += 1
             _meta(resource)["resourceVersion"] = str(self._rv)
-            self._store[self._key(resource)] = resource
+            self._store[key] = resource
             self._notify("MODIFIED", resource)
             return copy.deepcopy(resource)
 
@@ -198,8 +215,13 @@ class RestClient(Client):
         if self.config.insecure:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE
-        with urllib.request.urlopen(req, context=ctx, timeout=15) as resp:
-            return json.loads(resp.read() or b"null")
+        try:
+            with urllib.request.urlopen(req, context=ctx, timeout=15) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                raise ConflictError(str(e)) from e
+            raise
 
     def get_resource(self, api_version, kind, namespace, name):
         try:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import time
 
+from .client import ConflictError
 from .workqueue import WorkerQueue
 
 from ..engine.context import Context
@@ -120,7 +121,12 @@ class GenerateController:
             if mode == MODE_SKIP or resource is None:
                 continue
             if mode == MODE_CREATE:
-                self.client.create_resource(resource)
+                try:
+                    self.client.create_resource(resource)
+                except ConflictError:
+                    # AlreadyExists: another worker created it first — the
+                    # reference falls through to update (generate.go applyRule)
+                    self.client.update_resource(resource)
             elif mode == MODE_UPDATE:
                 self.client.update_resource(resource)
             meta = resource.get("metadata") or {}

@@ -40,20 +40,31 @@ class LeaderElector:
             "coordination.k8s.io/v1", "Lease", self.namespace, self.name)
 
     def try_acquire_or_renew(self) -> bool:
-        """One election round; returns current leadership."""
+        """One election round; returns current leadership.
+
+        Updates are compare-and-swap: the observed resourceVersion rides
+        along and a Conflict means another replica won the race — treat it
+        as a lost election (client-go's resourceVersion-guarded lease
+        update semantics), then confirm holdership by re-reading.
+        """
+        from .client import ConflictError
+
         now = time.time()
         lease = self._lease()
         if lease is None:
-            self.client.create_resource({
-                "apiVersion": "coordination.k8s.io/v1",
-                "kind": "Lease",
-                "metadata": {"name": self.name, "namespace": self.namespace},
-                "spec": {
-                    "holderIdentity": self.identity,
-                    "leaseDurationSeconds": int(LEASE_DURATION_S),
-                    "renewTime": now,
-                },
-            })
+            try:
+                self.client.create_resource({
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.name, "namespace": self.namespace},
+                    "spec": {
+                        "holderIdentity": self.identity,
+                        "leaseDurationSeconds": int(LEASE_DURATION_S),
+                        "renewTime": now,
+                    },
+                })
+            except ConflictError:
+                return self._transition(False)
             return self._transition(True)
 
         spec = lease.get("spec") or {}
@@ -65,7 +76,12 @@ class LeaderElector:
             spec["holderIdentity"] = self.identity
             spec["renewTime"] = now
             lease["spec"] = spec
-            self.client.update_resource(lease)
+            try:
+                # carries the observed metadata.resourceVersion -> CAS; a
+                # successful guarded write proves holdership, no re-read
+                self.client.update_resource(lease)
+            except ConflictError:
+                return self._transition(False)
             return self._transition(True)
         return self._transition(False)
 
@@ -99,6 +115,11 @@ class LeaderElector:
             if lease is not None and (lease.get("spec") or {}).get(
                 "holderIdentity"
             ) == self.identity:
+                from .client import ConflictError
+
                 lease["spec"]["holderIdentity"] = ""
-                self.client.update_resource(lease)
+                try:
+                    self.client.update_resource(lease)
+                except ConflictError:
+                    pass  # someone else already took the lease
             self._transition(False)

@@ -77,6 +77,14 @@ class ReportGenerator:
         self.client = client
         self._lock = threading.Lock()
         self._pending: list[dict] = []
+        # current-state result store: (ns, policy, rule, kind, name) -> result.
+        # Reports are REBUILT from this map each aggregate() — stored report
+        # objects are replaced, never merged, so deleted policies/resources
+        # don't accumulate stale rows (reportcontroller.go:682 cleanup).
+        self._results: dict[tuple, dict] = {}
+        # namespaces that ever emitted a report: an empty rebuild must still
+        # write (now-empty) reports for them, or stale rows would survive
+        self._known_ns: set[str] = set()
 
     def add(self, *responses: EngineResponse) -> None:
         with self._lock:
@@ -89,27 +97,51 @@ class ReportGenerator:
         with self._lock:
             self._pending.append(rcr)
 
+    def prune_policy(self, policy_name: str) -> None:
+        """Drop all results of a deleted policy (policy delete handler in
+        reportcontroller.go's full reconcile)."""
+        with self._lock:
+            self._results = {
+                k: v for k, v in self._results.items() if k[1] != policy_name
+            }
+
+    def prune_resource(self, kind: str, namespace: str, name: str) -> None:
+        """Drop all results for a deleted resource."""
+        with self._lock:
+            self._results = {
+                k: v for k, v in self._results.items()
+                if not (k[0] == namespace and k[3] == kind and k[4] == name)
+            }
+
+    def reconcile(self) -> None:
+        """Full rebuild: forget the current state so the next scan/audit
+        repopulates from scratch (prgen.ReconcileCh, main.go:260)."""
+        with self._lock:
+            self._results.clear()
+
     def aggregate(self) -> list[dict]:
         """reportcontroller.go:501 aggregateReports + :541 mergeRequests:
-        consume pending requests, emit the report objects."""
+        consume pending requests into the result store, emit report objects
+        rebuilt from the store."""
         with self._lock:
             pending = self._pending
             self._pending = []
-
-        by_namespace: dict[str, list[dict]] = {}
-        for rcr in pending:
-            ns = (rcr.get("metadata") or {}).get("namespace", "")
-            by_namespace.setdefault(ns, []).extend(rcr.get("results") or [])
+            for rcr in pending:
+                ns = (rcr.get("metadata") or {}).get("namespace", "")
+                for r in rcr.get("results") or []:
+                    res = (r.get("resources") or [{}])[0]
+                    self._results[(ns, r.get("policy"), r.get("rule"),
+                                   res.get("kind"), res.get("name"))] = r
+            by_namespace: dict[str, list[dict]] = {
+                ns: [] for ns in self._known_ns
+            }
+            for (ns, *_), r in sorted(self._results.items(),
+                                      key=lambda kv: kv[0]):
+                by_namespace.setdefault(ns, []).append(r)
+            self._known_ns.update(by_namespace)
 
         reports = []
         for ns, results in sorted(by_namespace.items()):
-            # dedup: last write per (policy, rule, resource) wins
-            merged: dict[tuple, dict] = {}
-            for r in results:
-                res = (r.get("resources") or [{}])[0]
-                merged[(r.get("policy"), r.get("rule"),
-                        res.get("kind"), res.get("name"))] = r
-            results = list(merged.values())
             if ns:
                 reports.append({
                     "apiVersion": "wgpolicyk8s.io/v1alpha2",
@@ -136,13 +168,8 @@ class ReportGenerator:
                 if existing is None:
                     self.client.create_resource(report)
                 else:
-                    # merge results into the stored report
-                    merged: dict[tuple, dict] = {}
-                    for r in (existing.get("results") or []) + report["results"]:
-                        res = (r.get("resources") or [{}])[0]
-                        merged[(r.get("policy"), r.get("rule"),
-                                res.get("kind"), res.get("name"))] = r
-                    existing["results"] = list(merged.values())
-                    existing["summary"] = _summary(existing["results"])
+                    # replace: the store IS the current state
+                    existing["results"] = report["results"]
+                    existing["summary"] = report["summary"]
                     self.client.update_resource(existing)
         return reports

@@ -34,10 +34,49 @@ def test_sharded_scan_matches_single_device(cps):
     verdicts, fails, passes = sharded_scan(cps, resources, mesh)
     assert verdicts.shape[0] == 21
 
-    single = cps.evaluate_device(cps.flatten(resources))
+    # sharded_scan resolves HOST cells via the oracle, so compare against
+    # the full single-chip evaluate (device + oracle)
+    single = cps.evaluate(resources)
     assert (verdicts == single).all()
+    assert not (verdicts == Verdict.HOST).any()
 
-    # report aggregation counts (over the padded batch; padding rows are
-    # NOT_APPLICABLE so they do not count)
     want_fails = (single == Verdict.FAIL).sum(axis=0)
     np.testing.assert_array_equal(fails, want_fails)
+
+
+def test_sharded_scan_resolves_host_lane():
+    """A policy set containing host-only rules (variables in the pattern)
+    must still produce their verdicts from a mesh scan — HOST cells resolve
+    through the CPU oracle and the pass/fail counts include them."""
+    from kyverno_tpu.api.load import load_policy
+
+    device_rule = {
+        "name": "no-latest",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"pattern": {"spec": {"containers": [{"image": "!*:latest"}]}}},
+    }
+    host_rule = {
+        "name": "name-is-itself",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"pattern": {"metadata": {
+            "name": "{{request.object.metadata.name}}"
+        }}},
+    }
+    cps = CompiledPolicySet([load_policy({
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "mixed-lanes"},
+        "spec": {"rules": [device_rule, host_rule]},
+    })])
+    assert bool(cps.tensors.rule_host_only[1])
+
+    resources = [make_pod(i) for i in range(13)]
+    verdicts, fails, passes = sharded_scan(cps, resources, make_mesh())
+
+    assert not (verdicts == Verdict.HOST).any()
+    # the host rule passes every pod (name == itself after substitution)
+    assert int(passes[1]) == len(resources)
+    # counts were recomputed over the resolved matrix
+    np.testing.assert_array_equal(fails, (verdicts == Verdict.FAIL).sum(axis=0))
+    # and the whole matrix matches the single-chip full evaluate
+    np.testing.assert_array_equal(verdicts, cps.evaluate(resources))

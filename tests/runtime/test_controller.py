@@ -92,6 +92,49 @@ class TestLeaderElection:
         a.try_acquire_or_renew()
         assert events == ["start"]
 
+    def test_cas_prevents_split_brain(self):
+        """Two replicas observing the same expired lease must not both win:
+        the loser's update carries a stale resourceVersion -> 409 -> lost
+        election (client-go lease semantics)."""
+        import copy
+
+        cluster = FakeCluster()
+        a = LeaderElector(cluster, identity="a")
+        a.try_acquire_or_renew()
+        # force expiry
+        lease = cluster.get_resource(
+            "coordination.k8s.io/v1", "Lease", "kyverno", "kyverno")
+        lease["spec"]["renewTime"] = 0
+        cluster.update_resource(lease)
+        stale = cluster.get_resource(
+            "coordination.k8s.io/v1", "Lease", "kyverno", "kyverno")
+
+        class StaleFirstRead:
+            """b's view: first get returns the pre-race snapshot."""
+
+            def __init__(self, inner, snapshot):
+                self._inner, self._snap, self._used = inner, snapshot, False
+
+            def get_resource(self, *args):
+                if not self._used:
+                    self._used = True
+                    return copy.deepcopy(self._snap)
+                return self._inner.get_resource(*args)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        b = LeaderElector(StaleFirstRead(cluster, stale), identity="b")
+        # a renews first (wins the race, bumps the resourceVersion)...
+        assert a.try_acquire_or_renew() is True
+        # ...then b writes against its stale read -> conflict -> loses
+        assert b.try_acquire_or_renew() is False
+        assert a.is_leader() and not b.is_leader()
+        holder = cluster.get_resource(
+            "coordination.k8s.io/v1", "Lease", "kyverno", "kyverno"
+        )["spec"]["holderIdentity"]
+        assert holder == "a"
+
 
 class TestControllerLifecycle:
     def test_end_to_end(self):

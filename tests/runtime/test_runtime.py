@@ -238,6 +238,42 @@ class TestAuditAndReports:
         assert built[0]["kind"] == "PolicyReport"
         assert built[0]["summary"]["fail"] == 1
 
+    def test_reports_prune_deleted_policy_and_resource(self):
+        """Stored reports are rebuilt from current state: pruning a deleted
+        policy/resource removes its rows instead of accumulating forever."""
+        from kyverno_tpu.runtime.client import FakeCluster
+
+        cluster = FakeCluster()
+        audit_doc = json.loads(json.dumps(ENFORCE_POLICY))
+        audit_doc["spec"]["validationFailureAction"] = "audit"
+        cache = PolicyCache()
+        cache.add(load_policy(audit_doc))
+        reports = ReportGenerator(client=cluster)
+        server = WebhookServer(policy_cache=cache, report_gen=reports)
+        server.audit_handler.run()
+        for name in ("p1", "p2"):
+            server.handle(VALIDATING_WEBHOOK_PATH, review(pod(name=name)))
+        server.audit_handler.drain()
+        server.audit_handler.stop()
+        built = reports.aggregate()
+        assert built[0]["summary"]["fail"] == 2
+
+        reports.prune_resource("Pod", "default", "p1")
+        built = reports.aggregate()
+        assert built[0]["summary"]["fail"] == 1
+        stored = cluster.get_resource(
+            "wgpolicyk8s.io/v1alpha2", "PolicyReport", "default",
+            "polr-ns-default")
+        assert len(stored["results"]) == 1  # replaced, not merged
+
+        reports.prune_policy(audit_doc["metadata"]["name"])
+        built = reports.aggregate()
+        assert built[0]["summary"]["fail"] == 0
+        stored = cluster.get_resource(
+            "wgpolicyk8s.io/v1alpha2", "PolicyReport", "default",
+            "polr-ns-default")
+        assert stored["results"] == []
+
 
 class TestConfig:
     def test_parse_kinds(self):
