@@ -4,9 +4,13 @@ Produces the static, device-resident representation of a policy set:
 
 - a path dictionary (generalized paths; array segments are ``*``)
 - flat check arrays (one row per leaf check)
-- glob-NFA tables for string operands (consumed by ops/glob.py)
+- aux arrays (match/exclude filters, precondition/deny conditions — one row
+  per primitive, reduced group -> filter/block -> rule on device)
+- glob-NFA tables for string operands (consumed by ops/glob.py); literal
+  NFAs compile metachars as plain bytes for exact-equality rows
 - rule/alt/group segment maps for the verdict reduction (ops/eval.py)
-- per-rule kind sets for the match prefilter
+- per-rule kind sets for the legacy prefilter (host-lane rules only;
+  device rules carry their full match program as aux rows)
 
 This is the ``policycache emits a precompiled policy tensor`` component of
 the north star (BASELINE.json) — the TPU analogue of
@@ -19,7 +23,18 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .ir import SEP, CheckAnchor, CheckOp, RuleIR
+from .ir import (
+    AUX_DENY,
+    AUX_EXCLUDE,
+    AUX_MATCH,
+    AUX_PRECOND,
+    AuxOp,
+    CheckAnchor,
+    CheckOp,
+    RuleIR,
+    SEP,
+    _title_first,
+)
 
 # Glob NFA geometry: patterns longer than NFA_STATES-1 chars or values
 # longer than STR_LEN bytes take the host lane.
@@ -62,6 +77,55 @@ class PolicyTensors:
     alt_rule: np.ndarray                  # [A] int32 rule row of each alt
     n_gates: int
 
+    # aux rows (X rows): match/exclude/precondition/deny primitives
+    ax_path: np.ndarray                   # [X] int32 path id (-1 constant)
+    ax_plen: np.ndarray                   # [X] int8 path segment count
+    ax_op: np.ndarray                     # [X] int8 AuxOp
+    ax_rule: np.ndarray                   # [X] int32
+    ax_group: np.ndarray                  # [X] int32 global aux-group id
+    ax_kind_req: np.ndarray               # [X] int32 kind id (-1 any)
+    ax_nfa: np.ndarray                    # [X] int32 (-1 none)
+    ax_absent: np.ndarray                 # [X] bool result for absent leaf
+    ax_err_absent: np.ndarray             # [X] bool deny: absent -> ERROR
+    ax_allow_num: np.ndarray              # [X] bool numeric keys allowed (In)
+    ax_key_pat: np.ndarray                # [X] bool key acts as the pattern
+    ax_obool: np.ndarray                  # [X] bool
+    ax_is_obool: np.ndarray               # [X] bool operand is bool
+    ax_is_ostr: np.ndarray                # [X] bool operand is string
+    ax_is_onum: np.ndarray                # [X] bool operand is numeric
+    ax_is_odur: np.ndarray                # [X] bool (strict, non-"0")
+    ax_is_odur_any: np.ndarray            # [X] bool
+    ax_is_ofloat: np.ndarray              # [X] bool
+    ax_is_oint: np.ndarray                # [X] bool
+    ax_is_oquant: np.ndarray              # [X] bool
+    ax_q_hi: np.ndarray                   # [X] int64 -> limbs in eval
+    ax_q_lo: np.ndarray
+    ax_s_hi: np.ndarray
+    ax_s_lo: np.ndarray
+
+    # aux groups (GX): rows OR within a group, then XOR negate
+    n_aux_groups: int
+    axg_negate: np.ndarray                # [GX] bool
+    axg_klass: np.ndarray                 # [GX] int8
+    axg_rule: np.ndarray                  # [GX] int32
+    axg_any: np.ndarray                   # [GX] bool (condition any-block)
+    axg_filt: np.ndarray                  # [GX] int32 global filter (-1)
+
+    # aux filters (FX): groups AND within a filter
+    n_aux_filters: int
+    axf_rule: np.ndarray                  # [FX] int32
+    axf_is_exclude: np.ndarray            # [FX] bool
+
+    # per-rule aux modes
+    rule_match_any: np.ndarray            # [R] bool (match.any -> OR)
+    rule_has_match: np.ndarray            # [R] bool (device match program)
+    rule_has_exclude: np.ndarray          # [R] bool
+    rule_exclude_all: np.ndarray          # [R] bool (exclude.all -> AND)
+    rule_has_precond: np.ndarray          # [R] bool
+    rule_precond_any: np.ndarray          # [R] bool (has an any-block)
+    rule_is_deny: np.ndarray              # [R] bool
+    rule_deny_any: np.ndarray             # [R] bool
+
     # NFA tables [N, S]
     nfa_char: np.ndarray                  # uint8 literal char (0 if meta)
     nfa_is_star: np.ndarray               # bool
@@ -81,11 +145,13 @@ class PolicyTensors:
         return len(self.paths)
 
 
-def _compile_glob(pattern: str):
+def _compile_glob(pattern: str, literal: bool = False):
     """Glob pattern -> NFA row (char / is_star / is_q per state). Runs of
-    '*' collapse to one so the NFA epsilon-closure is a single shift."""
-    while "**" in pattern:
-        pattern = pattern.replace("**", "*")
+    '*' collapse to one so the NFA epsilon-closure is a single shift.
+    ``literal`` compiles metachars as plain bytes (exact equality rows)."""
+    if not literal:
+        while "**" in pattern:
+            pattern = pattern.replace("**", "*")
     if len(pattern) > NFA_STATES - 1:
         return None
     char = np.zeros(NFA_STATES, dtype=np.uint8)
@@ -95,13 +161,21 @@ def _compile_glob(pattern: str):
         b = ch.encode("utf-8")
         if len(b) != 1:
             return None  # non-ASCII pattern: host lane
-        if ch == "*":
+        if ch == "*" and not literal:
             star[i] = True
-        elif ch == "?":
+        elif ch == "?" and not literal:
             q[i] = True
         else:
             char[i] = b[0]
     return char, star, q, len(pattern)
+
+
+_AUX_COL_NAMES = (
+    "path", "plen", "op", "rule", "group", "kind_req", "nfa", "absent",
+    "err_absent", "allow_num", "key_pat", "obool", "is_obool", "is_ostr",
+    "is_onum", "is_odur", "is_odur_any", "is_ofloat", "is_oint", "is_oquant",
+    "q", "s",
+)
 
 
 def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
@@ -115,19 +189,28 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         return path_index[p]
 
     nfa_rows = []
-    nfa_index: dict[str, int] = {}
+    nfa_index: dict[tuple[str, bool], int] = {}
 
-    def nfa_id(pattern: str, rule: RuleIR) -> int:
-        if pattern in nfa_index:
-            return nfa_index[pattern]
-        row = _compile_glob(pattern)
+    class _Host(Exception):
+        pass
+
+    def nfa_id(pattern: str, literal: bool = False) -> int:
+        key = (pattern, literal)
+        if key in nfa_index:
+            return nfa_index[key]
+        row = _compile_glob(pattern, literal)
         if row is None:
-            rule.host_only = True
-            rule.host_reason = f"glob pattern not NFA-compilable: {pattern!r}"
-            return -1
-        nfa_index[pattern] = len(nfa_rows)
+            raise _Host(f"glob pattern not NFA-compilable: {pattern!r}")
+        nfa_index[key] = len(nfa_rows)
         nfa_rows.append(row)
-        return nfa_index[pattern]
+        return nfa_index[key]
+
+    kind_index: dict[str, int] = {}
+
+    def kind_id(k: str) -> int:
+        if k not in kind_index:
+            kind_index[k] = len(kind_index)
+        return kind_index[k]
 
     # validate device-lane constraints that depend on tensor geometry
     for rule in rule_irs:
@@ -138,8 +221,13 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
                 rule.host_only = True
                 rule.host_reason = "path too deep"
                 break
+        for a in rule.aux_rows:
+            if a.path and len(a.path.split(SEP)) > MAX_SEGMENTS:
+                rule.host_only = True
+                rule.host_reason = "aux path too deep"
+                break
 
-    cols: dict[str, list] = {k: [] for k in (
+    chk_cols: dict[str, list] = {k: [] for k in (
         "path", "op", "rule", "alt", "group", "gate", "guard", "is_gate",
         "is_cond", "tracked", "exist", "nfa", "lo", "hi", "bool", "numfb",
         "track_depth", "cond_depth",
@@ -148,79 +236,174 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
     alt_rule: list[int] = []
     n_gates_total = 0
 
-    kind_index: dict[str, int] = {}
+    aux: dict[str, list] = {k: [] for k in _AUX_COL_NAMES}
+    axg_negate: list[bool] = []
+    axg_klass: list[int] = []
+    axg_rule: list[int] = []
+    axg_any: list[bool] = []
+    axg_filt: list[int] = []
+    axf_rule: list[int] = []
+    axf_is_exclude: list[bool] = []
 
-    def kind_id(k: str) -> int:
-        if k not in kind_index:
-            kind_index[k] = len(kind_index)
-        return kind_index[k]
+    n_rules = max((r.rule_index for r in rule_irs), default=-1) + 1
+    rule_match_any = np.zeros(n_rules, dtype=bool)
+    rule_has_match = np.zeros(n_rules, dtype=bool)
+    rule_has_exclude = np.zeros(n_rules, dtype=bool)
+    rule_exclude_all = np.zeros(n_rules, dtype=bool)
+    rule_has_precond = np.zeros(n_rules, dtype=bool)
+    rule_precond_any = np.zeros(n_rules, dtype=bool)
+    rule_is_deny = np.zeros(n_rules, dtype=bool)
+    rule_deny_any = np.zeros(n_rules, dtype=bool)
 
     for rule in rule_irs:
         if rule.host_only:
             continue
-        alt_base = len(alt_rule)
-        for _ in range(rule.n_alts):
-            alt_rule.append(rule.rule_index)
-        # renumber (alt, group) pairs globally
+        # -------- per-rule local buffers (no global rollback needed)
+        local_chk = {k: [] for k in chk_cols}
+        local_alt_rule: list[int] = []
+        local_group_alt: list[int] = []
         local_groups: dict[tuple[int, int], int] = {}
+        local_gates = rule.n_gates
+        local_aux = {k: [] for k in aux}
+        l_axg: list[tuple[bool, int, int, bool, int]] = []
+        l_axf: list[tuple[int, bool]] = []
+
+        alt_base = len(alt_rule)
+        group_base = len(group_alt)
         gate_base = n_gates_total
-        n_gates_total += rule.n_gates
+        aux_group_base = len(axg_negate)
+        aux_filter_base = len(axf_rule)
 
-        for c in rule.checks:
-            key = (c.alt, c.group)
-            if key not in local_groups:
-                local_groups[key] = len(group_alt)
-                group_alt.append(alt_base + c.alt)
-            gid = local_groups[key]
+        try:
+            for _ in range(rule.n_alts):
+                local_alt_rule.append(rule.rule_index)
 
-            n = -1
-            if c.op in (CheckOp.STR_EQ, CheckOp.STR_NE):
-                n = nfa_id(c.pattern_str, rule)
-                if rule.host_only:
-                    break
+            for c in rule.checks:
+                key = (c.alt, c.group)
+                if key not in local_groups:
+                    local_groups[key] = group_base + len(local_group_alt)
+                    local_group_alt.append(alt_base + c.alt)
+                gid = local_groups[key]
 
-            is_gate = c.anchor is CheckAnchor.ELEMENT_GATE
-            is_cond = c.anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL)
-            tracked = is_cond or is_gate or c.op is CheckOp.ABSENT or c.existence
-            segments = c.path.split(SEP)
-            if is_cond:
-                track_depth = c.cond_depth
-            elif c.existence:
-                track_depth = segments.index("*") if "*" in segments else len(segments)
-            elif is_gate or c.op is CheckOp.ABSENT:
-                track_depth = len(segments)
-            else:
-                track_depth = -1
+                n = -1
+                if c.op in (CheckOp.STR_EQ, CheckOp.STR_NE):
+                    n = nfa_id(c.pattern_str)
 
-            cols["path"].append(path_id(c.path))
-            cols["op"].append(int(c.op))
-            cols["rule"].append(rule.rule_index)
-            cols["alt"].append(alt_base + c.alt)
-            cols["group"].append(gid)
-            cols["gate"].append(gate_base + c.gate if c.gate >= 0 else -1)
-            cols["guard"].append(c.guard_mask)
-            cols["is_gate"].append(is_gate)
-            cols["is_cond"].append(is_cond)
-            cols["tracked"].append(tracked)
-            cols["exist"].append(c.existence)
-            cols["nfa"].append(n)
-            cols["lo"].append(c.num_lo)
-            cols["hi"].append(c.num_hi)
-            cols["bool"].append(c.bool_val)
-            cols["numfb"].append(c.num_fallback)
-            cols["track_depth"].append(track_depth)
-            cols["cond_depth"].append(c.cond_depth)
+                is_gate = c.anchor is CheckAnchor.ELEMENT_GATE
+                is_cond = c.anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL)
+                tracked = is_cond or is_gate or c.op is CheckOp.ABSENT or c.existence
+                segments = c.path.split(SEP)
+                if is_cond:
+                    track_depth = c.cond_depth
+                elif c.existence:
+                    track_depth = segments.index("*") if "*" in segments else len(segments)
+                elif is_gate or c.op is CheckOp.ABSENT:
+                    track_depth = len(segments)
+                else:
+                    track_depth = -1
 
-        if rule.host_only:
-            # roll back this rule's rows
-            n_rows = len([1 for r in cols["rule"] if r == rule.rule_index])
-            for k in cols:
-                cols[k] = cols[k][: len(cols[k]) - n_rows]
-            del alt_rule[alt_base:]
-            del group_alt[len(group_alt) - len(local_groups):]
-            n_gates_total = gate_base
+                local_chk["path"].append(path_id(c.path))
+                local_chk["op"].append(int(c.op))
+                local_chk["rule"].append(rule.rule_index)
+                local_chk["alt"].append(alt_base + c.alt)
+                local_chk["group"].append(gid)
+                local_chk["gate"].append(gate_base + c.gate if c.gate >= 0 else -1)
+                local_chk["guard"].append(c.guard_mask)
+                local_chk["is_gate"].append(is_gate)
+                local_chk["is_cond"].append(is_cond)
+                local_chk["tracked"].append(tracked)
+                local_chk["exist"].append(c.existence)
+                local_chk["nfa"].append(n)
+                local_chk["lo"].append(c.num_lo)
+                local_chk["hi"].append(c.num_hi)
+                local_chk["bool"].append(c.bool_val)
+                local_chk["numfb"].append(c.num_fallback)
+                local_chk["track_depth"].append(track_depth)
+                local_chk["cond_depth"].append(c.cond_depth)
 
-    n_rules = max((r.rule_index for r in rule_irs), default=-1) + 1
+            # -------- aux rows
+            filt_map: dict[tuple[int, int], int] = {}
+            group_map: dict[int, int] = {}
+            for a in rule.aux_rows:
+                if a.klass in (AUX_MATCH, AUX_EXCLUDE):
+                    fkey = (a.klass, a.filt)
+                    if fkey not in filt_map:
+                        filt_map[fkey] = aux_filter_base + len(l_axf)
+                        l_axf.append((rule.rule_index, a.klass == AUX_EXCLUDE))
+                    gfilt = filt_map[fkey]
+                else:
+                    gfilt = -1
+                if a.group not in group_map:
+                    group_map[a.group] = aux_group_base + len(l_axg)
+                    l_axg.append((a.group_negate, a.klass, rule.rule_index,
+                                  a.any_block, gfilt))
+                gid = group_map[a.group]
+
+                n = -1
+                if a.op in (AuxOp.GLOB, AuxOp.CIN_ITEM, AuxOp.CIN_GLOB) or (
+                    a.op is AuxOp.CEQ and a.o_is_str
+                ):
+                    n = nfa_id(a.pattern, a.literal)
+
+                kreq = kind_id(a.kind_req) if a.kind_req else -1
+                pid = path_id(a.path) if a.path else -1
+                plen = len(a.path.split(SEP)) if a.path else 0
+
+                local_aux["path"].append(pid)
+                local_aux["plen"].append(plen)
+                local_aux["op"].append(int(a.op))
+                local_aux["rule"].append(rule.rule_index)
+                local_aux["group"].append(gid)
+                local_aux["kind_req"].append(kreq)
+                local_aux["nfa"].append(n)
+                local_aux["absent"].append(a.absent_res)
+                local_aux["err_absent"].append(a.err_on_absent and bool(a.path))
+                local_aux["allow_num"].append(a.allow_num_key)
+                local_aux["key_pat"].append(a.key_is_pattern)
+                local_aux["obool"].append(a.o_bool)
+                local_aux["is_obool"].append(a.o_is_bool)
+                local_aux["is_ostr"].append(a.o_is_str)
+                local_aux["is_onum"].append(a.o_is_num)
+                local_aux["is_odur"].append(a.o_is_dur)
+                local_aux["is_odur_any"].append(a.o_is_dur_any)
+                local_aux["is_ofloat"].append(a.o_is_float)
+                local_aux["is_oint"].append(a.o_is_int)
+                local_aux["is_oquant"].append(a.o_is_quant)
+                local_aux["q"].append(a.o_qmicro)
+                local_aux["s"].append(a.o_smicro)
+        except _Host as e:
+            rule.host_only = True
+            rule.host_reason = str(e)
+            continue
+
+        # -------- commit the rule
+        for k in chk_cols:
+            chk_cols[k].extend(local_chk[k])
+        alt_rule.extend(local_alt_rule)
+        group_alt.extend(local_group_alt)
+        n_gates_total += local_gates
+        for k in aux:
+            aux[k].extend(local_aux[k])
+        for neg, klass, r_idx, any_b, gfilt in l_axg:
+            axg_negate.append(neg)
+            axg_klass.append(klass)
+            axg_rule.append(r_idx)
+            axg_any.append(any_b)
+            axg_filt.append(gfilt)
+        for r_idx, is_ex in l_axf:
+            axf_rule.append(r_idx)
+            axf_is_exclude.append(is_ex)
+
+        rule_match_any[rule.rule_index] = rule.match_any
+        rule_has_match[rule.rule_index] = rule.n_match_filters > 0
+        rule_has_exclude[rule.rule_index] = rule.n_exclude_filters > 0
+        rule_exclude_all[rule.rule_index] = rule.exclude_all
+        rule_has_precond[rule.rule_index] = rule.has_precond
+        rule_precond_any[rule.rule_index] = rule.precond_has_any
+        rule_is_deny[rule.rule_index] = rule.is_deny
+        rule_deny_any[rule.rule_index] = rule.deny_has_any
+
+    # legacy kind prefilter (host-lane rules route to the oracle by kind)
     kmax = max((len(r.kinds) for r in rule_irs), default=1) or 1
     rule_kinds = np.full((n_rules, kmax), -1, dtype=np.int32)
     rule_all_kinds = np.zeros(n_rules, dtype=bool)
@@ -231,8 +414,10 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
             if k == "*":
                 rule_all_kinds[rule.rule_index] = True
             else:
-                # "Pod" matches "Pod" and "v1/Pod" style GVKs; store bare kind
-                rule_kinds[rule.rule_index, j] = kind_id(k.split("/")[-1])
+                # "Pod" matches "Pod" and "v1/Pod" style GVKs; store the
+                # title-cased bare kind (utils.go checkKind title match)
+                rule_kinds[rule.rule_index, j] = kind_id(
+                    _title_first(k.split("/")[-1]))
 
     if nfa_rows:
         nfa_char = np.stack([r[0] for r in nfa_rows])
@@ -245,36 +430,80 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         nfa_q = np.zeros((1, NFA_STATES), dtype=bool)
         nfa_len = np.zeros(1, dtype=np.int32)
 
-    def arr(k, dtype):
+    def arr(cols, k, dtype):
         return np.array(cols[k], dtype=dtype)
+
+    q_arr = np.array(aux["q"], dtype=np.int64)
+    s_arr = np.array(aux["s"], dtype=np.int64)
 
     return PolicyTensors(
         paths=paths,
         path_index=path_index,
         path_wildcards=np.array([p.split(SEP).count("*") for p in paths], dtype=np.int32),
-        chk_path=arr("path", np.int32),
-        chk_op=arr("op", np.int8),
-        chk_rule=arr("rule", np.int32),
-        chk_alt_gid=arr("alt", np.int32),
-        chk_group_gid=arr("group", np.int32),
-        chk_gate=arr("gate", np.int32),
-        chk_guard=arr("guard", np.uint16),
-        chk_is_gate_row=arr("is_gate", bool),
-        chk_is_cond=arr("is_cond", bool),
-        chk_tracked=arr("tracked", bool),
-        chk_existence=arr("exist", bool),
-        chk_nfa=arr("nfa", np.int32),
-        chk_num_lo=arr("lo", np.int64),
-        chk_num_hi=arr("hi", np.int64),
-        chk_bool=arr("bool", bool),
-        chk_num_fallback=arr("numfb", bool),
-        chk_track_depth=arr("track_depth", np.int8),
-        chk_cond_depth=arr("cond_depth", np.int8),
+        chk_path=arr(chk_cols, "path", np.int32),
+        chk_op=arr(chk_cols, "op", np.int8),
+        chk_rule=arr(chk_cols, "rule", np.int32),
+        chk_alt_gid=arr(chk_cols, "alt", np.int32),
+        chk_group_gid=arr(chk_cols, "group", np.int32),
+        chk_gate=arr(chk_cols, "gate", np.int32),
+        chk_guard=arr(chk_cols, "guard", np.uint16),
+        chk_is_gate_row=arr(chk_cols, "is_gate", bool),
+        chk_is_cond=arr(chk_cols, "is_cond", bool),
+        chk_tracked=arr(chk_cols, "tracked", bool),
+        chk_existence=arr(chk_cols, "exist", bool),
+        chk_nfa=arr(chk_cols, "nfa", np.int32),
+        chk_num_lo=arr(chk_cols, "lo", np.int64),
+        chk_num_hi=arr(chk_cols, "hi", np.int64),
+        chk_bool=arr(chk_cols, "bool", bool),
+        chk_num_fallback=arr(chk_cols, "numfb", bool),
+        chk_track_depth=arr(chk_cols, "track_depth", np.int8),
+        chk_cond_depth=arr(chk_cols, "cond_depth", np.int8),
         n_groups=len(group_alt),
         n_alts=len(alt_rule),
         group_alt=np.array(group_alt, dtype=np.int32) if group_alt else np.zeros(0, np.int32),
         alt_rule=np.array(alt_rule, dtype=np.int32) if alt_rule else np.zeros(0, np.int32),
         n_gates=n_gates_total,
+        ax_path=arr(aux, "path", np.int32),
+        ax_plen=arr(aux, "plen", np.int8),
+        ax_op=arr(aux, "op", np.int8),
+        ax_rule=arr(aux, "rule", np.int32),
+        ax_group=arr(aux, "group", np.int32),
+        ax_kind_req=arr(aux, "kind_req", np.int32),
+        ax_nfa=arr(aux, "nfa", np.int32),
+        ax_absent=arr(aux, "absent", bool),
+        ax_err_absent=arr(aux, "err_absent", bool),
+        ax_allow_num=arr(aux, "allow_num", bool),
+        ax_key_pat=arr(aux, "key_pat", bool),
+        ax_obool=arr(aux, "obool", bool),
+        ax_is_obool=arr(aux, "is_obool", bool),
+        ax_is_ostr=arr(aux, "is_ostr", bool),
+        ax_is_onum=arr(aux, "is_onum", bool),
+        ax_is_odur=arr(aux, "is_odur", bool),
+        ax_is_odur_any=arr(aux, "is_odur_any", bool),
+        ax_is_ofloat=arr(aux, "is_ofloat", bool),
+        ax_is_oint=arr(aux, "is_oint", bool),
+        ax_is_oquant=arr(aux, "is_oquant", bool),
+        ax_q_hi=(q_arr >> 31).astype(np.int32),
+        ax_q_lo=(q_arr & 0x7FFFFFFF).astype(np.int32),
+        ax_s_hi=(s_arr >> 31).astype(np.int32),
+        ax_s_lo=(s_arr & 0x7FFFFFFF).astype(np.int32),
+        n_aux_groups=len(axg_negate),
+        axg_negate=np.array(axg_negate, dtype=bool),
+        axg_klass=np.array(axg_klass, dtype=np.int8),
+        axg_rule=np.array(axg_rule, dtype=np.int32),
+        axg_any=np.array(axg_any, dtype=bool),
+        axg_filt=np.array(axg_filt, dtype=np.int32),
+        n_aux_filters=len(axf_rule),
+        axf_rule=np.array(axf_rule, dtype=np.int32),
+        axf_is_exclude=np.array(axf_is_exclude, dtype=bool),
+        rule_match_any=rule_match_any,
+        rule_has_match=rule_has_match,
+        rule_has_exclude=rule_has_exclude,
+        rule_exclude_all=rule_exclude_all,
+        rule_has_precond=rule_has_precond,
+        rule_precond_any=rule_precond_any,
+        rule_is_deny=rule_is_deny,
+        rule_deny_any=rule_deny_any,
         nfa_char=nfa_char,
         nfa_is_star=nfa_star,
         nfa_is_q=nfa_q,

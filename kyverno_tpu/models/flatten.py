@@ -10,8 +10,14 @@ For every path in the compiled dictionary, enumerate the resource's slots
   PASS" (validate.go DefaultHandler vs validateArrayOfMaps over []).
 - value features: type tag, interned string id (values stringify the Go way
   for wildcard comparison, pattern.go:309), i64 micro-units for anything
-  quantity-parseable, bool value, and the top-level element index for gate
-  alignment.
+  quantity-parseable, plain-float/int flags and duration micro-seconds for
+  the condition operators (variables/operator/*.go), bool value, and the
+  top-level element index for gate alignment.
+
+Paths rooted at ir.REQ_MARK resolve against the per-resource *request
+envelope* (operation, namespace, userInfo — admission context) instead of
+the resource body; ir.NSEFF_MARK resolves to the effective namespace
+(resource name for Namespace kinds, utils.go checkNamespace).
 
 Strings are interned into a per-batch dictionary; the NFA kernel matches
 patterns against the *dictionary* once and verdicts gather by id — the
@@ -24,10 +30,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..utils.duration import DurationError, parse_duration
 from ..utils.gofmt import value_to_string_for_equality
 from ..utils.quantity import QuantityError, parse_quantity
 from .compiler import STR_LEN, PolicyTensors
-from .ir import NUM_MAX, NUM_SCALE, SEP
+from .ir import NSEFF_MARK, NUM_MAX, NUM_SCALE, REQ_MARK, SEP
 
 # type tags
 T_ABSENT, T_NULL, T_BOOL, T_NUM, T_STR, T_OBJ, T_LIST = range(7)
@@ -44,7 +51,13 @@ class FlatBatch:
     num_val: np.ndarray       # [B, P, E] int64 (host-side reference)
     num_hi: np.ndarray        # [B, P, E] int32 high limb (value >> 31)
     num_lo: np.ndarray        # [B, P, E] int32 low limb (value & 0x7FFFFFFF)
-    num_ok: np.ndarray        # [B, P, E] bool
+    num_ok: np.ndarray        # [B, P, E] bool (k8s-quantity-parseable)
+    num_plain: np.ndarray     # [B, P, E] bool (plain strconv float)
+    num_int: np.ndarray       # [B, P, E] bool (python/Go int value)
+    dur_hi: np.ndarray        # [B, P, E] int32 duration micro-seconds limbs
+    dur_lo: np.ndarray        # [B, P, E] int32
+    dur_ok: np.ndarray        # [B, P, E] bool (duration-parseable, not "0")
+    dur_any: np.ndarray       # [B, P, E] bool (duration-parseable incl "0")
     bool_val: np.ndarray      # [B, P, E] bool
     elem0: np.ndarray         # [B, P, E] int32 top-level element index (-1)
     kind_id: np.ndarray       # [B] int32 (-1 unknown kind)
@@ -52,7 +65,18 @@ class FlatBatch:
     # string dictionary
     str_bytes: np.ndarray     # [V, STR_LEN] uint8
     str_len: np.ndarray       # [V] int32
+    str_has_glob: np.ndarray  # [V] bool ('*' or '?' byte present)
     strings: list[str]
+
+    def device_args(self) -> tuple:
+        """Canonical argument order for ops.eval.build_eval_fn output."""
+        return (
+            self.mask, self.slot_valid, self.type_tag, self.str_id,
+            self.num_hi, self.num_lo, self.num_ok, self.num_plain,
+            self.num_int, self.dur_hi, self.dur_lo, self.dur_ok,
+            self.dur_any, self.bool_val, self.elem0, self.kind_id,
+            self.host_flag, self.str_bytes, self.str_len, self.str_has_glob,
+        )
 
 
 class _Interner:
@@ -88,10 +112,47 @@ def _value_to_micro(value) -> int | None:
     return int(micro)
 
 
-def _enumerate_slots(resource, segments: list[str]):
-    """Yield (mask, elem0, leaf_value_or_None) for every chain of
-    ``segments`` through ``resource``. A phantom slot (leaf None + short
-    mask) marks a broken chain. Empty arrays yield nothing."""
+def _duration_micro(value: str) -> int | None:
+    """Go-duration parse -> micro-seconds. ``dur_ok`` (strict) additionally
+    excludes the literal "0" (operator.go:82 parseDuration); ``dur_any``
+    keeps it (duration.go's deprecated Duration* handlers accept it)."""
+    try:
+        secs = parse_duration(value)
+    except DurationError:
+        return None
+    micro = round(secs * 1_000_000)
+    if abs(micro) > NUM_MAX:
+        return None
+    return micro
+
+
+def _effective_namespace(resource: dict) -> str:
+    meta = resource.get("metadata") or {}
+    if resource.get("kind") == "Namespace":
+        return meta.get("name") or ""
+    return meta.get("namespace") or ""
+
+
+def _enumerate_slots(resource, segments: list[str], request: dict,
+                     ns_eff: str):
+    """Yield (mask, elem0, leaf_value_or_None, leaf_present) for every chain
+    of ``segments`` through the resource (or the request envelope / the
+    effective-namespace synthetic). A phantom slot (leaf None + short mask)
+    marks a broken chain. Empty arrays yield nothing."""
+    if segments and segments[0] == NSEFF_MARK:
+        return [(0b11, -1, ns_eff, True)]
+    if segments and segments[0] == REQ_MARK:
+        root = request
+        segments = segments[1:]
+        base_mask = 0b11 if request else 0b1
+        if not segments:
+            return [(base_mask, -1, None, False)]
+        offset = 1
+    else:
+        root = resource
+        base_mask = 0b1
+        offset = 0
+
     out = []
 
     def walk(node, i: int, mask: int, elem0: int):
@@ -99,25 +160,35 @@ def _enumerate_slots(resource, segments: list[str]):
             out.append((mask, elem0, node, True))
             return
         seg = segments[i]
+        bit = 1 << (i + 1 + offset)
         if seg == "*":
             if not isinstance(node, list):
                 out.append((mask, elem0, None, False))
                 return
             for idx, el in enumerate(node):
-                walk(el, i + 1, mask | (1 << (i + 1)), idx if elem0 < 0 else elem0)
+                walk(el, i + 1, mask | bit, idx if elem0 < 0 else elem0)
         else:
             if not isinstance(node, dict) or seg not in node:
                 out.append((mask, elem0, None, False))
                 return
-            walk(node[seg], i + 1, mask | (1 << (i + 1)), elem0)
+            walk(node[seg], i + 1, mask | bit, elem0)
 
-    walk(resource, 0, 1, -1)  # bit 0: the root itself
+    if root is None or (offset == 1 and not request):
+        return [(base_mask, -1, None, False)]
+    walk(root, 0, base_mask, -1)  # bit 0: the root itself
     return out
 
 
-def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int = 16) -> FlatBatch:
+def flatten_batch(resources: list[dict], tensors: PolicyTensors,
+                  max_slots: int = 16,
+                  requests: list[dict] | None = None) -> FlatBatch:
+    """``requests`` optionally supplies per-resource admission envelopes
+    (operation/namespace/userInfo) backing REQ_MARK paths; a background
+    scan passes none and request.* condition keys resolve as absent, the
+    same way the oracle's scan context leaves them unresolved."""
     B, P = len(resources), tensors.n_paths
     path_segments = [p.split(SEP) for p in tensors.paths]
+    envelopes = requests if requests is not None else [{}] * B
 
     # first pass: find E
     all_slots: list[list] = []
@@ -125,8 +196,10 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
     host_flag = np.zeros(B, dtype=bool)
     for b, resource in enumerate(resources):
         row = []
+        ns_eff = _effective_namespace(resource) if isinstance(resource, dict) else ""
+        env = envelopes[b] or {}
         for segs in path_segments:
-            slots = _enumerate_slots(resource, segs)
+            slots = _enumerate_slots(resource, segs, env, ns_eff)
             if len(slots) > max_slots:
                 host_flag[b] = True
                 slots = slots[:max_slots]
@@ -142,6 +215,11 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
     str_id = np.full((B, P, E), -1, dtype=np.int32)
     num_val = np.zeros((B, P, E), dtype=np.int64)
     num_ok = np.zeros((B, P, E), dtype=bool)
+    num_plain = np.zeros((B, P, E), dtype=bool)
+    num_int = np.zeros((B, P, E), dtype=bool)
+    dur_val = np.zeros((B, P, E), dtype=np.int64)
+    dur_ok = np.zeros((B, P, E), dtype=bool)
+    dur_any = np.zeros((B, P, E), dtype=bool)
     bool_val = np.zeros((B, P, E), dtype=bool)
     elem0 = np.full((B, P, E), -1, dtype=np.int32)
     kind_id = np.full(B, -1, dtype=np.int32)
@@ -164,6 +242,7 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
                     str_id[b, p, e] = interner.intern("true" if value else "false")
                 elif isinstance(value, (int, float)):
                     type_tag[b, p, e] = T_NUM
+                    num_int[b, p, e] = isinstance(value, int)
                     s = value_to_string_for_equality(value)
                     if len(s) <= STR_LEN:
                         str_id[b, p, e] = interner.intern(s)
@@ -171,6 +250,7 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
                     if n is not None:
                         num_val[b, p, e] = n
                         num_ok[b, p, e] = True
+                        num_plain[b, p, e] = True
                     else:
                         host_flag[b] = True
                 elif isinstance(value, str):
@@ -183,6 +263,16 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
                     if n is not None:
                         num_val[b, p, e] = n
                         num_ok[b, p, e] = True
+                        try:
+                            float(value)
+                            num_plain[b, p, e] = True
+                        except ValueError:
+                            pass
+                    d = _duration_micro(value)
+                    if d is not None:
+                        dur_val[b, p, e] = d
+                        dur_any[b, p, e] = True
+                        dur_ok[b, p, e] = value != "0"
                 elif isinstance(value, dict):
                     type_tag[b, p, e] = T_OBJ
                 else:
@@ -190,19 +280,26 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors, max_slots: int 
 
     num_hi = (num_val >> 31).astype(np.int32)
     num_lo = (num_val & 0x7FFFFFFF).astype(np.int32)
+    dur_hi = (dur_val >> 31).astype(np.int32)
+    dur_lo = (dur_val & 0x7FFFFFFF).astype(np.int32)
 
     V = max(1, len(interner.strings))
     str_bytes = np.zeros((V, STR_LEN), dtype=np.uint8)
     str_len = np.zeros(V, dtype=np.int32)
+    str_has_glob = np.zeros(V, dtype=bool)
     for i, s in enumerate(interner.strings):
         bs = s.encode("utf-8")[:STR_LEN]
         str_bytes[i, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
         str_len[i] = len(bs)
+        str_has_glob[i] = "*" in s or "?" in s
 
     return FlatBatch(
         n=B, e=E, mask=mask, slot_valid=slot_valid, type_tag=type_tag,
         str_id=str_id, num_val=num_val, num_hi=num_hi, num_lo=num_lo,
-        num_ok=num_ok, bool_val=bool_val,
+        num_ok=num_ok, num_plain=num_plain, num_int=num_int,
+        dur_hi=dur_hi, dur_lo=dur_lo, dur_ok=dur_ok, dur_any=dur_any,
+        bool_val=bool_val,
         elem0=elem0, kind_id=kind_id, host_flag=host_flag,
-        str_bytes=str_bytes, str_len=str_len, strings=interner.strings,
+        str_bytes=str_bytes, str_len=str_len, str_has_glob=str_has_glob,
+        strings=interner.strings,
     )

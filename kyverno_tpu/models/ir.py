@@ -37,6 +37,13 @@ from ..utils.quantity import QuantityError, parse_quantity
 # with display_path() for messages.
 SEP = "\x1f"
 
+# Reserved first segments for paths that resolve outside the resource body:
+# REQ_MARK roots in the per-request envelope (operation, namespace, ...);
+# NSEFF_MARK is the "effective namespace" (resource name for Namespace
+# kinds, metadata.namespace otherwise — utils.go checkNamespace semantics).
+REQ_MARK = "\x02req"
+NSEFF_MARK = "\x02nseff"
+
 
 def display_path(path: str) -> str:
     return "/" + path.replace(SEP, "/")
@@ -69,6 +76,74 @@ class CheckAnchor(IntEnum):
 
 class HostOnly(Exception):
     """Raised during compilation when a construct needs the CPU oracle."""
+
+
+# ----------------------------------------------------------------- aux rows
+#
+# Match/exclude filters (utils.go:265 MatchesResourceDescription) and
+# precondition/deny condition lists (variables/evaluate.go:11) compile to
+# "aux rows": per-(resource, rule) boolean programs evaluated alongside the
+# pattern checks. Rows OR within a group; a group's result XORs with its
+# negate flag; groups AND within a filter (match/exclude) or combine as
+# any/all blocks (conditions).
+
+
+AUX_MATCH = 0
+AUX_EXCLUDE = 1
+AUX_PRECOND = 2
+AUX_DENY = 3
+
+
+class AuxOp(IntEnum):
+    TRUE = 0          # constant (kind-only rows / folded static conditions)
+    FALSE = 1
+    GLOB = 2          # NFA(pattern) over the value string at path
+    EXISTS = 3        # leaf present
+    NOT_EXISTS = 4    # leaf absent
+    CEQ = 5           # condition Equals (operator/equal.go semantics)
+    CIN_ITEM = 6      # In-family: key exact-equals one static item
+    CIN_GLOB = 7      # In-family: single-string value is a pattern over key
+    CGT = 8           # numeric.go family
+    CGE = 9
+    CLT = 10
+    CLE = 11
+    DGT = 12          # duration.go family (deprecated Duration* operators)
+    DGE = 13
+    DLT = 14
+    DLE = 15
+
+
+@dataclass
+class AuxIR:
+    klass: int                  # AUX_MATCH/AUX_EXCLUDE/AUX_PRECOND/AUX_DENY
+    op: AuxOp
+    path: str = ""              # SEP path ("" for constant rows); may start
+                                # with REQ_MARK / NSEFF_MARK
+    group: int = 0              # local group id (rows OR within a group)
+    filt: int = 0               # filter index (match/exclude only)
+    any_block: bool = False     # conditions: member of the any-list
+    group_negate: bool = False  # NotEquals/NotIn...: negate the group OR
+    kind_req: str = ""          # match rows: bare-kind gate ("" = any kind)
+    pattern: str = ""           # glob / literal pattern operand
+    literal: bool = False       # pattern matches byte-exact (no metachars)
+    absent_res: bool = False    # row result when the leaf is absent
+    err_on_absent: bool = False # deny rows: absent key -> rule ERROR
+    allow_num_key: bool = True  # False for AllIn (numeric key -> False)
+    key_is_pattern: bool = False  # In over a list value: the (dynamic) key
+                                  # acts as the wildcard pattern -> a key
+                                  # containing metachars goes to the oracle
+    # condition operand encoding (CEQ / C* numeric rows)
+    o_bool: bool = False
+    o_is_bool: bool = False
+    o_is_str: bool = False
+    o_is_dur: bool = False      # operand parses as a Go duration (non-"0")
+    o_is_dur_any: bool = False  # parses as a duration, "0" included
+    o_is_float: bool = False    # operand string parses as a plain float
+    o_is_int: bool = False      # operand string parses via strconv.Atoi
+    o_is_num: bool = False      # operand is a numeric literal
+    o_is_quant: bool = False    # operand parses as a k8s quantity
+    o_qmicro: int = 0           # quantity/plain-number micro-units
+    o_smicro: int = 0           # duration seconds (or numeric) micro-units
 
 
 # Scaled integer representation for numbers/quantities: micro-units in i64.
@@ -140,6 +215,17 @@ class RuleIR:
     host_reason: str = ""
     # gate group -> array-prefix path (for element alignment validation)
     gate_prefix: dict[int, str] = field(default_factory=dict)
+    # aux program (match/exclude filters + precondition/deny conditions)
+    aux_rows: list[AuxIR] = field(default_factory=list)
+    n_aux_groups: int = 0
+    n_match_filters: int = 0
+    n_exclude_filters: int = 0
+    match_any: bool = False          # match.any -> OR over filters (else AND)
+    exclude_all: bool = False        # exclude.all -> AND over filters (else OR)
+    has_precond: bool = False
+    precond_has_any: bool = False    # preconditions carry an any-block
+    is_deny: bool = False
+    deny_has_any: bool = False
 
 
 _HAS_VAR = re.compile("|".join([REGEX_VARIABLES.pattern, REGEX_REFERENCES.pattern]))
@@ -400,6 +486,492 @@ class _PatternCompiler:
         return check
 
 
+# ------------------------------------------------------------ aux compilers
+
+
+def _title_first(s: str) -> str:
+    return s[:1].upper() + s[1:] if s else s
+
+
+def _matches_empty(pattern: str) -> bool:
+    from ..utils.wildcard import wildcard_match
+
+    return wildcard_match(pattern, "")
+
+
+class _AuxBuilder:
+    """Emits AuxIR rows for one rule, allocating group/filter ids."""
+
+    def __init__(self, ir: RuleIR):
+        self.ir = ir
+
+    def new_group(self) -> int:
+        g = self.ir.n_aux_groups
+        self.ir.n_aux_groups += 1
+        return g
+
+    def row(self, klass: int, op: AuxOp, group: int, **kw) -> AuxIR:
+        r = AuxIR(klass=klass, op=op, group=group, **kw)
+        self.ir.aux_rows.append(r)
+        return r
+
+
+# --------------------------------------------------------- match compilation
+
+
+def compile_match_program(rule, policy_namespace: str, ir: RuleIR) -> None:
+    """Match/exclude -> aux rows (utils.go:265 MatchesResourceDescription).
+
+    Raises HostOnly for constructs needing admission context (userinfo,
+    namespaceSelector) or dynamic key expansion (wildcard annotation/label
+    keys)."""
+    b = _AuxBuilder(ir)
+    match = rule.match
+    if match.any:
+        ir.match_any = True
+        filters = list(match.any)
+    elif match.all:
+        filters = list(match.all)
+    else:
+        from ..api.types import ResourceFilter
+
+        filters = [ResourceFilter(user_info=match.user_info,
+                                  resources=match.resources)]
+    ir.n_match_filters = len(filters)
+    for fi, rf in enumerate(filters):
+        _compile_filter(b, rf, AUX_MATCH, fi, policy_namespace)
+
+    exclude = rule.exclude
+    if exclude.any:
+        ex_filters = list(exclude.any)
+    elif exclude.all:
+        ir.exclude_all = True
+        ex_filters = list(exclude.all)
+    else:
+        from ..api.types import ResourceFilter
+
+        rf = ResourceFilter(user_info=exclude.user_info,
+                            resources=exclude.resources)
+        ex_filters = [] if rf.is_empty() else [rf]
+    ir.n_exclude_filters = len(ex_filters)
+    for fi, rf in enumerate(ex_filters):
+        _compile_filter(b, rf, AUX_EXCLUDE, fi, policy_namespace)
+
+
+def _compile_filter(b: _AuxBuilder, rf, klass: int, fi: int,
+                    policy_namespace: str) -> None:
+    """One ResourceFilter -> AND of groups (doesResourceMatchConditionBlock).
+
+    An exclude filter with only an empty block never excludes
+    (_exclude_helper); an empty match filter never matches."""
+    if not rf.user_info.is_empty():
+        # roles/clusterRoles/subjects need live admission context; in a
+        # batched scan the oracle result also differs from admission — the
+        # whole rule takes the host lane (utils.go:196-234)
+        raise HostOnly("userinfo in match/exclude")
+    desc = rf.resources
+    if desc.namespace_selector is not None:
+        raise HostOnly("namespaceSelector needs namespace labels")
+    if desc.is_empty():
+        if klass == AUX_MATCH:
+            # "match cannot be empty" -> filter never matches
+            b.row(klass, AuxOp.FALSE, b.new_group(), filt=fi)
+        return
+
+    if desc.kinds:
+        g = b.new_group()
+        for entry in desc.kinds:
+            parts = entry.split("/")
+            if entry == "*":
+                b.row(klass, AuxOp.TRUE, g, filt=fi)
+            elif len(parts) == 1:
+                b.row(klass, AuxOp.TRUE, g, filt=fi,
+                      kind_req=_title_first(entry))
+            elif len(parts) == 2:
+                # version/Kind: resource version must equal parts[0]
+                # (checkKind matches version regardless of group)
+                kind = _title_first(parts[1])
+                b.row(klass, AuxOp.GLOB, g, filt=fi, kind_req=kind,
+                      path="apiVersion", pattern=parts[0])
+                b.row(klass, AuxOp.GLOB, g, filt=fi, kind_req=kind,
+                      path="apiVersion", pattern=f"*/{parts[0]}")
+            elif len(parts) == 3:
+                kind = _title_first(parts[2])
+                version = "*" if parts[1] == "*" else parts[1]
+                b.row(klass, AuxOp.GLOB, g, filt=fi, kind_req=kind,
+                      path="apiVersion", pattern=f"{parts[0]}/{version}")
+            else:
+                raise HostOnly(f"unparseable kind {entry!r}")
+
+    name_patterns = ([desc.name] if desc.name else []) + list(desc.names or [])
+    if desc.name and desc.names:
+        # both present: reference ANDs the two checks
+        g = b.new_group()
+        b.row(klass, AuxOp.GLOB, g, filt=fi, path=f"metadata{SEP}name",
+              pattern=desc.name, absent_res=_matches_empty(desc.name))
+        name_patterns = list(desc.names)
+    if name_patterns:
+        g = b.new_group()
+        for p in name_patterns:
+            b.row(klass, AuxOp.GLOB, g, filt=fi, path=f"metadata{SEP}name",
+                  pattern=p, absent_res=_matches_empty(p))
+
+    if desc.namespaces:
+        g = b.new_group()
+        for p in desc.namespaces:
+            b.row(klass, AuxOp.GLOB, g, filt=fi, path=NSEFF_MARK,
+                  pattern=p, absent_res=_matches_empty(p))
+
+    for k, v in (desc.annotations or {}).items():
+        if "*" in k or "?" in k:
+            raise HostOnly("wildcard annotation key in match")
+        g = b.new_group()
+        b.row(klass, AuxOp.GLOB, g, filt=fi,
+              path=f"metadata{SEP}annotations{SEP}{k}", pattern=str(v))
+
+    if desc.selector is not None:
+        _compile_selector(b, desc.selector, klass, fi)
+
+    if policy_namespace:
+        # namespaced Policy objects only apply inside their own namespace
+        g = b.new_group()
+        b.row(klass, AuxOp.GLOB, g, filt=fi,
+              path=f"metadata{SEP}namespace", pattern=policy_namespace,
+              literal=True)
+
+
+def _compile_selector(b: _AuxBuilder, selector: dict, klass: int, fi: int) -> None:
+    """LabelSelector -> groups over metadata.labels paths. Kyverno expands
+    wildcards in matchLabels values (wildcards.ReplaceInSelector), which a
+    glob row reproduces; wildcard *keys* need dynamic expansion -> host."""
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if "*" in k or "?" in k:
+            raise HostOnly("wildcard label key in selector")
+        g = b.new_group()
+        b.row(klass, AuxOp.GLOB, g, filt=fi,
+              path=f"metadata{SEP}labels{SEP}{k}", pattern=str(v))
+    for expr in selector.get("matchExpressions") or []:
+        k = expr.get("key", "")
+        if "*" in k or "?" in k:
+            raise HostOnly("wildcard label key in matchExpressions")
+        op = (expr.get("operator") or "").lower()
+        values = [str(x) for x in (expr.get("values") or [])]
+        path = f"metadata{SEP}labels{SEP}{k}"
+        g = b.new_group()
+        if op == "in":
+            for v in values:
+                b.row(klass, AuxOp.GLOB, g, filt=fi, path=path, pattern=v,
+                      literal=True)
+        elif op == "notin":
+            # absent key satisfies NotIn (k8s labels.Requirement.Matches)
+            for v in values:
+                b.row(klass, AuxOp.GLOB, g, filt=fi, path=path, pattern=v,
+                      literal=True, group_negate=True)
+            if not values:
+                b.row(klass, AuxOp.FALSE, g, filt=fi, group_negate=True)
+        elif op == "exists":
+            b.row(klass, AuxOp.EXISTS, g, filt=fi, path=path)
+        elif op == "doesnotexist":
+            b.row(klass, AuxOp.NOT_EXISTS, g, filt=fi, path=path,
+                  absent_res=True)
+        else:
+            raise HostOnly(f"selector operator {op!r}")
+
+
+# ----------------------------------------------------- condition compilation
+
+
+_VAR_PATH_SEG = re.compile(r'^(?:"([^"]*)"|([A-Za-z0-9_\-./]+))$')
+
+
+def _parse_condition_key(key) -> list[str] | None:
+    """A key that is exactly one ``{{request...}}`` variable with plain
+    dotted segments -> path segments (resource-rooted for request.object.*,
+    REQ_MARK-rooted otherwise). None => not device-compilable."""
+    if not isinstance(key, str):
+        return None
+    m = re.fullmatch(r"\{\{(.+)\}\}", key.strip())
+    if m is None:
+        return None
+    inner = m.group(1).strip()
+    # split on dots, honoring double-quoted segments
+    segs: list[str] = []
+    buf = ""
+    in_quote = False
+    for ch in inner:
+        if ch == '"':
+            in_quote = not in_quote
+            buf += ch
+        elif ch == "." and not in_quote:
+            segs.append(buf)
+            buf = ""
+        else:
+            buf += ch
+    segs.append(buf)
+    out: list[str] = []
+    for s in segs:
+        sm = _VAR_PATH_SEG.match(s)
+        if sm is None or s == "":
+            return None
+        seg = sm.group(1) if sm.group(1) is not None else sm.group(2)
+        if seg is None or seg == "" or "." in (sm.group(2) or ""):
+            # bare segments may not contain dots (they were split) — but a
+            # segment like "metadata-name" is fine; dots only via quotes
+            pass
+        out.append(seg)
+    if not out or out[0] != "request":
+        return None
+    if len(out) >= 2 and out[1] == "object":
+        rest = out[2:]
+        if not rest:
+            return None  # whole-object key: host
+        return rest
+    rest = out[1:]
+    if not rest:
+        return None
+    return [REQ_MARK] + rest
+
+
+def compile_conditions(raw, klass: int, ir: RuleIR) -> None:
+    """Precondition / deny condition lists -> aux rows
+    (variables/evaluate.go:21 EvaluateConditions)."""
+    b = _AuxBuilder(ir)
+    if isinstance(raw, dict):
+        if not set(raw) <= {"any", "all"}:
+            raise HostOnly("invalid conditions block")
+        any_conds = raw.get("any") or []
+        all_conds = raw.get("all") or []
+    elif isinstance(raw, list):
+        any_conds, all_conds = [], raw
+    else:
+        raise HostOnly("invalid conditions")
+    if klass == AUX_PRECOND:
+        ir.has_precond = True
+        ir.precond_has_any = bool(any_conds)
+    else:
+        ir.deny_has_any = bool(any_conds)
+    for cond in any_conds:
+        _compile_condition(b, cond, klass, any_block=True)
+    for cond in all_conds:
+        _compile_condition(b, cond, klass, any_block=False)
+
+
+def _static_quant_micro(s):
+    try:
+        return quantity_to_micro(s)
+    except (HostOnly, QuantityError):
+        return None
+
+
+def _operand_flags(value) -> dict:
+    """Static operand -> the flag set the device branches on."""
+    from ..utils.duration import DurationError, parse_duration
+
+    kw: dict = {}
+    if isinstance(value, bool):
+        kw["o_is_bool"] = True
+        kw["o_bool"] = value
+    elif isinstance(value, (int, float)):
+        kw["o_is_num"] = True
+        m = _static_quant_micro(value)
+        if m is None:
+            raise HostOnly(f"operand precision: {value!r}")
+        kw["o_qmicro"] = m
+        kw["o_smicro"] = m  # numeric operand doubles as seconds
+        kw["o_is_quant"] = True
+    elif isinstance(value, str):
+        kw["o_is_str"] = True
+        try:
+            secs = parse_duration(value)
+            kw["o_is_dur_any"] = True
+            kw["o_is_dur"] = value != "0"  # operator.go:82 excludes "0"
+            kw["o_smicro"] = round(secs * 1_000_000)
+        except DurationError:
+            pass
+        try:
+            float(value)
+            kw["o_is_float"] = True
+            if not kw.get("o_is_dur_any"):
+                m = _static_quant_micro(value)
+                if m is None:
+                    raise HostOnly(f"operand precision: {value!r}")
+                kw["o_smicro"] = m
+        except ValueError:
+            pass
+        try:
+            int(value, 10)
+            kw["o_is_int"] = True
+        except ValueError:
+            pass
+        m = _static_quant_micro(value)
+        if m is not None:
+            kw["o_qmicro"] = m
+            kw["o_is_quant"] = True
+    else:
+        raise HostOnly("non-scalar condition operand")
+    return kw
+
+
+def _compile_condition(b: _AuxBuilder, cond: dict, klass: int,
+                       any_block: bool) -> None:
+    from ..engine.operators import evaluate_condition
+
+    key = cond.get("key")
+    op = (cond.get("operator") or "").lower()
+    value = cond.get("value")
+
+    def has_var(x) -> bool:
+        return _contains_variable(x)
+
+    if has_var(value):
+        raise HostOnly("variables in condition value")
+
+    err_absent = klass == AUX_DENY  # deny substitution errors on unresolved
+
+    if not has_var(key):
+        # fully static condition: fold to a constant
+        result = evaluate_condition(key, cond.get("operator", ""), value)
+        b.row(klass, AuxOp.TRUE if result else AuxOp.FALSE, b.new_group(),
+              any_block=any_block)
+        return
+
+    segs = _parse_condition_key(key)
+    if segs is None:
+        raise HostOnly(f"condition key not compilable: {key!r}")
+    path = SEP.join(segs)
+    if "*" in segs:
+        raise HostOnly("wildcard in condition key path")
+    g = b.new_group()
+    common = dict(path=path, any_block=any_block, err_on_absent=err_absent,
+                  filt=0)
+
+    def absent_result(operator: str) -> bool:
+        # unresolved precondition keys substitute to "" (vars.go:62-74)
+        return evaluate_condition("", operator, value)
+
+    if op in ("equals", "equal", "notequals", "notequal"):
+        if isinstance(value, (dict, list)):
+            # scalar paths never deep-equal a composite operand
+            base = False
+            negate = op.startswith("notequal")
+            res = base != negate
+            b.row(klass, AuxOp.TRUE if res else AuxOp.FALSE, g,
+                  any_block=any_block, path=path if err_absent else "",
+                  err_on_absent=err_absent)
+            return
+        kw = _operand_flags(value)
+        negate = op in ("notequals", "notequal")
+        b.row(klass, AuxOp.CEQ, g, group_negate=negate,
+              absent_res=absent_result("equals"),
+              pattern=value if isinstance(value, str) else "",
+              **common, **kw)
+    elif op in ("in", "anyin", "allin", "notin", "anynotin", "allnotin"):
+        negate = op in ("notin", "anynotin", "allnotin")
+        coerce = op in ("anyin", "allin", "anynotin", "allnotin")
+        allow_num = op != "allin"
+        raw_abs = absent_result("in" if not negate else "notin")
+        # row-level absent results must be pre-negation
+        # (item, is_glob_row, key_is_pattern)
+        item_rows: list[tuple[str, bool, bool]] = []
+        if isinstance(value, list):
+            items = []
+            for el in value:
+                if isinstance(el, str):
+                    items.append(el)
+                elif coerce:
+                    items.append(_go_sprint(el))
+                else:
+                    # In/NotIn with non-string items: invalid -> False
+                    b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                          path=path if err_absent else "",
+                          err_on_absent=err_absent)
+                    return
+            # in.go:62 keyExistsInArray: the KEY is the wildcard pattern
+            # over list items — exact on device, HOST for metachar keys
+            item_rows = [(it, False, True) for it in items]
+        elif isinstance(value, str):
+            item_rows = [(value, True, False)]
+            try:
+                import json as _json
+
+                arr = _json.loads(value)
+                if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
+                    item_rows += [(it, False, False) for it in arr]
+            except ValueError:
+                pass
+        else:
+            # numeric/bool value: invalid type -> condition False
+            b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                  path=path if err_absent else "", err_on_absent=err_absent)
+            return
+        for item, is_glob, key_pat in item_rows:
+            b.row(klass, AuxOp.CIN_GLOB if is_glob else AuxOp.CIN_ITEM, g,
+                  group_negate=negate, pattern=item, literal=not is_glob,
+                  absent_res=(wildcard_match_static(item, "") if is_glob
+                              else item == ""),
+                  allow_num_key=allow_num, key_is_pattern=key_pat, **common)
+        if not item_rows:
+            b.row(klass, AuxOp.FALSE, g, group_negate=negate,
+                  any_block=any_block, path=path if err_absent else "",
+                  err_on_absent=err_absent, absent_res=raw_abs)
+    elif op in ("greaterthan", "greaterthanorequals", "lessthan",
+                "lessthanorequals"):
+        aux_op = {
+            "greaterthan": AuxOp.CGT,
+            "greaterthanorequals": AuxOp.CGE,
+            "lessthan": AuxOp.CLT,
+            "lessthanorequals": AuxOp.CLE,
+        }[op]
+        if isinstance(value, (dict, list)):
+            b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                  path=path if err_absent else "", err_on_absent=err_absent)
+            return
+        kw = _operand_flags(value)
+        b.row(klass, aux_op, g, absent_res=absent_result(op),
+              **common, **kw)
+    elif op in ("durationgreaterthan", "durationgreaterthanorequals",
+                "durationlessthan", "durationlessthanorequals"):
+        aux_op = {
+            "durationgreaterthan": AuxOp.DGT,
+            "durationgreaterthanorequals": AuxOp.DGE,
+            "durationlessthan": AuxOp.DLT,
+            "durationlessthanorequals": AuxOp.DLE,
+        }[op]
+        if isinstance(value, (dict, list)) or isinstance(value, bool):
+            b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                  path=path if err_absent else "", err_on_absent=err_absent)
+            return
+        kw = _operand_flags(value)
+        if not (kw.get("o_is_dur_any") or kw.get("o_is_num")):
+            b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                  path=path if err_absent else "", err_on_absent=err_absent)
+            return
+        b.row(klass, aux_op, g, absent_res=absent_result(op), **common, **kw)
+    else:
+        # unknown operator evaluates to false (evaluate.go default)
+        b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+              path=path if err_absent else "", err_on_absent=err_absent)
+
+
+def _go_sprint(v) -> str:
+    """fmt.Sprint for condition items (operators._sprint twin)."""
+    import math
+
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "<nil>"
+    if isinstance(v, float) and v == math.trunc(v) and abs(v) < 1e21:
+        return str(int(v))
+    return str(v)
+
+
+def wildcard_match_static(pattern: str, s: str) -> bool:
+    from ..utils.wildcard import wildcard_match
+
+    return wildcard_match(pattern, s)
+
+
 _RANGE_RE = re.compile(r"^(\d+(?:\.\d+)?[^-!]*?)(!?-)(\d+(?:\.\d+)?.*)$")
 
 
@@ -412,7 +984,13 @@ def _split_range(pattern: str, op: Op) -> tuple[int, int]:
 
 
 def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
-    """Compile one validate rule to IR, falling back to host_only."""
+    """Compile one validate rule to IR, falling back to host_only.
+
+    Device-lane coverage: pattern/anyPattern rules, deny rules with
+    static-operand conditions, preconditions over request.object paths,
+    any/all match filters, exclude blocks, name/namespace/annotation/
+    selector matching. Context rules, foreach, userinfo matching, and
+    {{variables}} outside condition keys stay on the CPU oracle."""
     ir = RuleIR(
         policy_name=policy.name,
         rule_name=rule.name,
@@ -426,42 +1004,44 @@ def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
         ir.host_only = True
         ir.host_reason = reason
         ir.checks = []
+        ir.aux_rows = []
         return ir
 
     v = rule.validation
-    if v.foreach or v.deny is not None:
-        return host("foreach/deny rules")
+    if v.foreach:
+        return host("foreach rules")
     if rule.context:
         return host("external context")
-    if rule.preconditions is not None:
-        return host("preconditions")
-    if not rule.exclude.is_empty():
-        return host("exclude block")
-    if rule.match.any or rule.match.all:
-        return host("any/all match filters")
-    if rule.match.resources.selector or rule.match.resources.namespace_selector:
-        return host("label selectors")
-    if rule.match.resources.annotations or rule.match.resources.name or rule.match.resources.names:
-        return host("name/annotation match")
-    if not rule.match.user_info.is_empty():
-        return host("userinfo match")
 
-    patterns = []
-    if v.pattern is not None:
-        if _contains_variable(v.pattern):
-            return host("variables in pattern")
-        patterns = [v.pattern]
-    elif v.any_pattern is not None:
-        if not isinstance(v.any_pattern, list):
-            return host("malformed anyPattern")
-        if _contains_variable(v.any_pattern):
-            return host("variables in anyPattern")
-        patterns = v.any_pattern
-    else:
-        return host("no pattern")
-
-    ir.n_alts = len(patterns)
     try:
+        compile_match_program(rule, getattr(policy, "namespace", ""), ir)
+        if rule.preconditions is not None:
+            compile_conditions(rule.preconditions, AUX_PRECOND, ir)
+
+        if v.deny is not None:
+            ir.is_deny = True
+            conditions = (v.deny or {}).get("conditions")
+            if conditions is None:
+                return host("deny without conditions")
+            compile_conditions(conditions, AUX_DENY, ir)
+            ir.n_alts = 0
+            return ir
+
+        patterns = []
+        if v.pattern is not None:
+            if _contains_variable(v.pattern):
+                return host("variables in pattern")
+            patterns = [v.pattern]
+        elif v.any_pattern is not None:
+            if not isinstance(v.any_pattern, list):
+                return host("malformed anyPattern")
+            if _contains_variable(v.any_pattern):
+                return host("variables in anyPattern")
+            patterns = v.any_pattern
+        else:
+            return host("no pattern")
+
+        ir.n_alts = len(patterns)
         for alt, pattern in enumerate(patterns):
             _PatternCompiler(ir, alt).compile(pattern)
     except (HostOnly, QuantityError) as e:
