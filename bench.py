@@ -61,12 +61,7 @@ def main() -> None:
     batch = cps.flatten(resources)
     flatten_s = time.monotonic() - t0
 
-    args = (
-        batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
-        batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
-        batch.elem0, batch.kind_id, batch.host_flag, batch.str_bytes,
-        batch.str_len,
-    )
+    args = batch.device_args()
 
     fn = cps.eval_fn
     out = fn(*args)

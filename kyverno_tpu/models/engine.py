@@ -79,12 +79,7 @@ class CompiledPolicySet:
 
     def evaluate_device(self, batch: FlatBatch) -> np.ndarray:
         """Device verdicts [B, R] (host-lane rows = Verdict.HOST)."""
-        out = self.eval_fn(
-            batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
-            batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
-            batch.elem0, batch.kind_id, batch.host_flag, batch.str_bytes,
-            batch.str_len,
-        )
+        out = self.eval_fn(*batch.device_args())
         return np.array(out)
 
     # ------------------------------------------------------------ full

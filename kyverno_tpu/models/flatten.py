@@ -39,6 +39,18 @@ from .ir import NSEFF_MARK, NUM_MAX, NUM_SCALE, REQ_MARK, SEP
 # type tags
 T_ABSENT, T_NULL, T_BOOL, T_NUM, T_STR, T_OBJ, T_LIST = range(7)
 
+# Canonical device-argument order. BATCH_ARRAYS are [B, ...] and shard over
+# the mesh's data axis; DICT_ARRAYS are per-batch string-dictionary tables
+# and replicate. pad_batch, the eval kernel signature, and the mesh
+# shardings all derive from these two tuples — one source of truth.
+BATCH_ARRAYS = (
+    "mask", "slot_valid", "null_break", "type_tag", "str_id",
+    "num_hi", "num_lo", "num_ok", "num_plain", "num_int",
+    "dur_hi", "dur_lo", "dur_ok", "dur_any", "bool_val",
+    "elem0", "kind_id", "host_flag", "live",
+)
+DICT_ARRAYS = ("str_bytes", "str_len", "str_has_glob")
+
 
 @dataclass
 class FlatBatch:
@@ -46,6 +58,10 @@ class FlatBatch:
     e: int                    # slots per path
     mask: np.ndarray          # [B, P, E] uint16 prefix bits
     slot_valid: np.ndarray    # [B, P, E] bool
+    null_break: np.ndarray    # [B, P, E] bool — chain broke at a non-dict
+                              # node (null/scalar/list parent): JMESPath
+                              # field access yields null, NOT a missing-key
+                              # error (engine/jmespath/interpreter._field)
     type_tag: np.ndarray      # [B, P, E] int8
     str_id: np.ndarray        # [B, P, E] int32 (-1 none)
     num_val: np.ndarray       # [B, P, E] int64 (host-side reference)
@@ -62,6 +78,10 @@ class FlatBatch:
     elem0: np.ndarray         # [B, P, E] int32 top-level element index (-1)
     kind_id: np.ndarray       # [B] int32 (-1 unknown kind)
     host_flag: np.ndarray     # [B] bool — needs the CPU oracle
+    live: np.ndarray          # [B] bool — real resource (False = mesh pad;
+                              # a real resource may legitimately have zero
+                              # valid slots when every path crosses an
+                              # empty array, so liveness is explicit)
     # string dictionary
     str_bytes: np.ndarray     # [V, STR_LEN] uint8
     str_len: np.ndarray       # [V] int32
@@ -70,13 +90,7 @@ class FlatBatch:
 
     def device_args(self) -> tuple:
         """Canonical argument order for ops.eval.build_eval_fn output."""
-        return (
-            self.mask, self.slot_valid, self.type_tag, self.str_id,
-            self.num_hi, self.num_lo, self.num_ok, self.num_plain,
-            self.num_int, self.dur_hi, self.dur_lo, self.dur_ok,
-            self.dur_any, self.bool_val, self.elem0, self.kind_id,
-            self.host_flag, self.str_bytes, self.str_len, self.str_has_glob,
-        )
+        return tuple(getattr(self, k) for k in BATCH_ARRAYS + DICT_ARRAYS)
 
 
 class _Interner:
@@ -135,18 +149,22 @@ def _effective_namespace(resource: dict) -> str:
 
 def _enumerate_slots(resource, segments: list[str], request: dict,
                      ns_eff: str):
-    """Yield (mask, elem0, leaf_value_or_None, leaf_present) for every chain
-    of ``segments`` through the resource (or the request envelope / the
-    effective-namespace synthetic). A phantom slot (leaf None + short mask)
-    marks a broken chain. Empty arrays yield nothing."""
+    """Yield (mask, elem0, leaf_value_or_None, leaf_present, null_break)
+    for every chain of ``segments`` through the resource (or the request
+    envelope / the effective-namespace synthetic). A phantom slot (leaf None
+    + short mask) marks a broken chain; ``null_break`` records that the
+    break happened at a node that exists but is not a map — the JMESPath
+    fork resolves such a path to null instead of raising NotFound
+    (interpreter._field), which conditions treat as a null key, not an
+    unresolved variable. Empty arrays yield nothing."""
     if segments and segments[0] == NSEFF_MARK:
-        return [(0b11, -1, ns_eff, True)]
+        return [(0b11, -1, ns_eff, True, False)]
     if segments and segments[0] == REQ_MARK:
         root = request
         segments = segments[1:]
         base_mask = 0b11 if request else 0b1
         if not segments:
-            return [(base_mask, -1, None, False)]
+            return [(base_mask, -1, None, False, False)]
         offset = 1
     else:
         root = resource
@@ -157,24 +175,27 @@ def _enumerate_slots(resource, segments: list[str], request: dict,
 
     def walk(node, i: int, mask: int, elem0: int):
         if i == len(segments):
-            out.append((mask, elem0, node, True))
+            out.append((mask, elem0, node, True, False))
             return
         seg = segments[i]
         bit = 1 << (i + 1 + offset)
         if seg == "*":
             if not isinstance(node, list):
-                out.append((mask, elem0, None, False))
+                out.append((mask, elem0, None, False, False))
                 return
             for idx, el in enumerate(node):
                 walk(el, i + 1, mask | bit, idx if elem0 < 0 else elem0)
         else:
-            if not isinstance(node, dict) or seg not in node:
-                out.append((mask, elem0, None, False))
+            if not isinstance(node, dict):
+                out.append((mask, elem0, None, False, True))
+                return
+            if seg not in node:
+                out.append((mask, elem0, None, False, False))
                 return
             walk(node[seg], i + 1, mask | bit, elem0)
 
     if root is None or (offset == 1 and not request):
-        return [(base_mask, -1, None, False)]
+        return [(base_mask, -1, None, False, False)]
     walk(root, 0, base_mask, -1)  # bit 0: the root itself
     return out
 
@@ -211,6 +232,7 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
     interner = _Interner()
     mask = np.zeros((B, P, E), dtype=np.uint16)
     slot_valid = np.zeros((B, P, E), dtype=bool)
+    null_break = np.zeros((B, P, E), dtype=bool)
     type_tag = np.full((B, P, E), T_ABSENT, dtype=np.int8)
     str_id = np.full((B, P, E), -1, dtype=np.int32)
     num_val = np.zeros((B, P, E), dtype=np.int64)
@@ -228,9 +250,10 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
         kind = (resource.get("kind") or "") if isinstance(resource, dict) else ""
         kind_id[b] = tensors.kind_index.get(kind, -1)
         for p in range(P):
-            for e, (m, e0, value, leaf) in enumerate(all_slots[b][p]):
+            for e, (m, e0, value, leaf, nbrk) in enumerate(all_slots[b][p]):
                 mask[b, p, e] = m
                 slot_valid[b, p, e] = True
+                null_break[b, p, e] = nbrk
                 elem0[b, p, e] = e0
                 if not leaf:
                     continue
@@ -294,12 +317,14 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
         str_has_glob[i] = "*" in s or "?" in s
 
     return FlatBatch(
-        n=B, e=E, mask=mask, slot_valid=slot_valid, type_tag=type_tag,
+        n=B, e=E, mask=mask, slot_valid=slot_valid, null_break=null_break,
+        type_tag=type_tag,
         str_id=str_id, num_val=num_val, num_hi=num_hi, num_lo=num_lo,
         num_ok=num_ok, num_plain=num_plain, num_int=num_int,
         dur_hi=dur_hi, dur_lo=dur_lo, dur_ok=dur_ok, dur_any=dur_any,
         bool_val=bool_val,
         elem0=elem0, kind_id=kind_id, host_flag=host_flag,
+        live=np.ones(B, dtype=bool),
         str_bytes=str_bytes, str_len=str_len, str_has_glob=str_has_glob,
         strings=interner.strings,
     )

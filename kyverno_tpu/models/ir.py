@@ -741,15 +741,18 @@ def compile_conditions(raw, klass: int, ir: RuleIR) -> None:
             raise HostOnly("invalid conditions block")
         any_conds = raw.get("any") or []
         all_conds = raw.get("all") or []
+        # a PRESENT-but-empty any-list still fails the block: evaluate.go
+        # checks `anyConditions != nil` and any([]) is false
+        has_any = raw.get("any") is not None
     elif isinstance(raw, list):
-        any_conds, all_conds = [], raw
+        any_conds, all_conds, has_any = [], raw, False
     else:
         raise HostOnly("invalid conditions")
     if klass == AUX_PRECOND:
         ir.has_precond = True
-        ir.precond_has_any = bool(any_conds)
+        ir.precond_has_any = has_any
     else:
-        ir.deny_has_any = bool(any_conds)
+        ir.deny_has_any = has_any
     for cond in any_conds:
         _compile_condition(b, cond, klass, any_block=True)
     for cond in all_conds:
@@ -891,14 +894,23 @@ def _compile_condition(b: _AuxBuilder, cond: dict, klass: int,
             item_rows = [(it, False, True) for it in items]
         elif isinstance(value, str):
             item_rows = [(value, True, False)]
-            try:
-                import json as _json
+            import json as _json
 
+            try:
                 arr = _json.loads(value)
-                if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
-                    item_rows += [(it, False, False) for it in arr]
             except ValueError:
-                pass
+                arr = None
+            if isinstance(arr, list) and all(isinstance(x, str) for x in arr):
+                item_rows += [(it, False, False) for it in arr]
+            elif negate:
+                # in.go:62 quirk: with a string value that is not a JSON
+                # string-array, a wildcard miss returns invalid-type, and
+                # every Not* handler maps invalid to FALSE — so the negated
+                # condition is constant false whether the key matches or not
+                b.row(klass, AuxOp.FALSE, g, any_block=any_block,
+                      path=path if err_absent else "",
+                      err_on_absent=err_absent)
+                return
         else:
             # numeric/bool value: invalid type -> condition False
             b.row(klass, AuxOp.FALSE, g, any_block=any_block,

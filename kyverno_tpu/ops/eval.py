@@ -8,29 +8,43 @@ fixed dataflow over the compiled check rows:
   2. per-check, per-slot leaf comparison + anchor masks     [B, C, E]
   3. element reduction (AND / existence-OR / gate open)     [B, C]
   4. group OR -> alternative AND -> rule verdict            [B, R]
+  5. aux programs: match/exclude filters, preconditions,
+     deny conditions over the ax_* rows                     [B, X] -> [B, R]
+  6. verdict composition: match miss -> NOT_APPLICABLE,
+     failed precondition -> SKIP, met deny -> FAIL, deny
+     key unresolved -> ERROR (utils.go:265 match semantics,
+     variables/evaluate.go:11 conditions)
 
 All shapes are static; reductions are segment-sums over precomputed id
 maps — no data-dependent control flow, everything fuses under jit.
 
 Verdict codes (the Pass/Fail/Skip/Error lattice of
 /root/reference/pkg/engine/response/status.go):
-  0 = not applicable (kind prefilter miss / no rule response)
+  0 = not applicable (match miss / no rule response)
   1 = pass, 2 = fail, 3 = skip, 4 = error, 5 = host lane
 """
 
 from __future__ import annotations
-
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..models.compiler import PolicyTensors
-from ..models.ir import SEP, CheckOp
+from ..models.ir import (
+    AUX_DENY,
+    AUX_EXCLUDE,
+    AUX_MATCH,
+    AUX_PRECOND,
+    AuxOp,
+    CheckOp,
+    SEP,
+)
 from .glob import glob_match_matrix
 
 V_NOT_APPLICABLE, V_PASS, V_FAIL, V_SKIP, V_ERROR, V_HOST = range(6)
+
+_DEBUG = None  # set to a dict to return aux intermediates for debugging
 
 # type tags (mirror models/flatten.py)
 T_ABSENT, T_NULL, T_BOOL, T_NUM, T_STR, T_OBJ, T_LIST = range(7)
@@ -52,12 +66,12 @@ def _lex_eq(ah, al, bh, bl):
 
 def _segment_or(values, segment_ids, num_segments):
     """OR-reduce [C, ...] bool rows into segments."""
-    return jax.ops.segment_max(values.astype(jnp.int8), segment_ids,
+    return jax.ops.segment_max(values.astype(jnp.int32), segment_ids,
                                num_segments=num_segments) > 0
 
 
 def _segment_and(values, segment_ids, num_segments):
-    return jax.ops.segment_min(values.astype(jnp.int8), segment_ids,
+    return jax.ops.segment_min(values.astype(jnp.int32), segment_ids,
                                num_segments=num_segments) > 0
 
 
@@ -98,6 +112,13 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
     rule_kind_ids = jnp.asarray(tensors.rule_kind_ids)
     rule_all_kinds = jnp.asarray(tensors.rule_match_all_kinds)
     rule_host = jnp.asarray(tensors.rule_host_only)
+    rule_deny = jnp.asarray(tensors.rule_is_deny)
+    rule_deny_any = jnp.asarray(tensors.rule_deny_any)
+    rule_precond_any = jnp.asarray(tensors.rule_precond_any)
+    rule_match_any = jnp.asarray(tensors.rule_match_any)
+    rule_has_match = jnp.asarray(tensors.rule_has_match)
+    rule_has_exclude = jnp.asarray(tensors.rule_has_exclude)
+    rule_exclude_all = jnp.asarray(tensors.rule_exclude_all)
 
     nfa_char = jnp.asarray(tensors.nfa_char)
     nfa_star = jnp.asarray(tensors.nfa_is_star)
@@ -109,8 +130,57 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
     n_rules = max(tensors.n_rules, 1)
     n_gates = max(tensors.n_gates, 1)
 
-    def evaluate(mask, slot_valid, type_tag, str_id, num_hi, num_lo, num_ok,
-                 bool_val, elem0, kind_id, host_flag, str_bytes, str_len):
+    # static: which rules have at least one device alternative (computed on
+    # host — an on-device scatter over empty alt_rule aborts the TPU backend)
+    covered_np = np.zeros(n_rules, dtype=bool)
+    covered_np[tensors.alt_rule] = True
+    covered = jnp.asarray(covered_np)
+
+    # aux static columns (X rows — match/exclude/precondition/deny program)
+    X = int(tensors.ax_op.size)
+    n_axg = max(tensors.n_aux_groups, 1)
+    n_axf = max(tensors.n_aux_filters, 1)
+    if X:
+        ax_klass_np = tensors.axg_klass[tensors.ax_group]
+        x_path = jnp.asarray(np.maximum(tensors.ax_path, 0))
+        x_has_path = jnp.asarray(tensors.ax_path >= 0)
+        x_plen = jnp.asarray(tensors.ax_plen.astype(np.int32))
+        x_op = jnp.asarray(tensors.ax_op.astype(np.int32))
+        x_rule = jnp.asarray(tensors.ax_rule)
+        x_group = jnp.asarray(tensors.ax_group)
+        x_kind = jnp.asarray(tensors.ax_kind_req)
+        x_nfa = jnp.asarray(np.maximum(tensors.ax_nfa, 0))
+        x_has_nfa = jnp.asarray(tensors.ax_nfa >= 0)
+        x_absent = jnp.asarray(tensors.ax_absent)
+        x_err = jnp.asarray(tensors.ax_err_absent)
+        x_allow_num = jnp.asarray(tensors.ax_allow_num)
+        x_key_pat = jnp.asarray(tensors.ax_key_pat)
+        x_obool = jnp.asarray(tensors.ax_obool)
+        x_o_bool = jnp.asarray(tensors.ax_is_obool)
+        x_o_str = jnp.asarray(tensors.ax_is_ostr)
+        x_o_num = jnp.asarray(tensors.ax_is_onum)
+        x_o_dur = jnp.asarray(tensors.ax_is_odur)
+        x_o_float = jnp.asarray(tensors.ax_is_ofloat)
+        x_o_int = jnp.asarray(tensors.ax_is_oint)
+        x_o_quant = jnp.asarray(tensors.ax_is_oquant)
+        x_q_h = jnp.asarray(tensors.ax_q_hi)
+        x_q_l = jnp.asarray(tensors.ax_q_lo)
+        x_s_h = jnp.asarray(tensors.ax_s_hi)
+        x_s_l = jnp.asarray(tensors.ax_s_lo)
+        x_is_match_klass = jnp.asarray(
+            (ax_klass_np == AUX_MATCH) | (ax_klass_np == AUX_EXCLUDE))
+        axg_negate = jnp.asarray(tensors.axg_negate)
+        axg_klass = jnp.asarray(tensors.axg_klass.astype(np.int32))
+        axg_rule = jnp.asarray(tensors.axg_rule)
+        axg_any = jnp.asarray(tensors.axg_any)
+        axg_filt = jnp.asarray(tensors.axg_filt)
+        axf_rule = jnp.asarray(tensors.axf_rule)
+        axf_is_ex = jnp.asarray(tensors.axf_is_exclude)
+
+    def evaluate(mask, slot_valid, null_break, type_tag, str_id, num_hi,
+                 num_lo, num_ok, num_plain, num_int, dur_hi, dur_lo, dur_ok,
+                 dur_any, bool_val, elem0, kind_id, host_flag, live,
+                 str_bytes, str_len, str_has_glob):
         B = mask.shape[0]
         C = c_path.shape[0]
         E = mask.shape[2]
@@ -120,217 +190,493 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                                      str_bytes, str_len)
         empty_str = str_len == 0                              # for IS_NULL
 
-        # ---- stage 2: gather slots per check  [B, C, E]
-        def g(x):
-            return jnp.take(x, c_path, axis=1)
+        if C:
+            # ---- stage 2: gather slots per check  [B, C, E]
+            def g(x):
+                return jnp.take(x, c_path, axis=1)
 
-        mask_c = g(mask).astype(jnp.int32)
-        valid_c = g(slot_valid)
-        type_c = g(type_tag).astype(jnp.int32)
-        sid_c = g(str_id)
-        numh_c = g(num_hi)
-        numl_c = g(num_lo)
-        numok_c = g(num_ok)
-        bool_c = g(bool_val)
-        elem0_c = g(elem0)
+            mask_c = g(mask).astype(jnp.int32)
+            valid_c = g(slot_valid)
+            type_c = g(type_tag).astype(jnp.int32)
+            sid_c = g(str_id)
+            numh_c = g(num_hi)
+            numl_c = g(num_lo)
+            numok_c = g(num_ok)
+            bool_c = g(bool_val)
+            elem0_c = g(elem0)
 
-        # chain analysis per slot: bits 1..plen must be present; the FIRST
-        # absent bit decides the outcome (fail, or pass when that depth is
-        # equality-guarded; leaf depth is an implicit guard for ABSENT)
-        leaf_bit = (1 << c_plen)[None, :, None]
-        want_bits = (leaf_bit << 1) - 2
-        absent_bits = (~mask_c) & want_bits
-        first_absent = absent_bits & (-absent_bits)
-        leaf_present = absent_bits == 0
-        guard_pass = (first_absent & c_guard[None, :, None]) != 0
+            # chain analysis per slot: bits 1..plen must be present; the FIRST
+            # absent bit decides the outcome (fail, or pass when that depth is
+            # equality-guarded; leaf depth is an implicit guard for ABSENT)
+            leaf_bit = (1 << c_plen)[None, :, None]
+            want_bits = (leaf_bit << 1) - 2
+            absent_bits = (~mask_c) & want_bits
+            first_absent = absent_bits & (-absent_bits)
+            leaf_present = absent_bits == 0
+            guard_pass = (first_absent & c_guard[None, :, None]) != 0
 
-        # string match: gather by dictionary id (id -1 -> no string form)
-        has_sid = sid_c >= 0
-        str_hit = match_nv[c_nfa[None, :, None], jnp.maximum(sid_c, 0)] & has_sid & c_has_nfa[None, :, None]
-        # value stringification exists only for str/bool/num leaves
-        stringy = (type_c == T_STR) | (type_c == T_BOOL) | (type_c == T_NUM)
+            # string match: gather by dictionary id (id -1 -> no string form)
+            has_sid = sid_c >= 0
+            str_hit = match_nv[c_nfa[None, :, None], jnp.maximum(sid_c, 0)] & has_sid & c_has_nfa[None, :, None]
+            # value stringification exists only for str/bool/num leaves
+            stringy = (type_c == T_STR) | (type_c == T_BOOL) | (type_c == T_NUM)
 
-        lo_h, lo_l = c_lo_h[None, :, None], c_lo_l[None, :, None]
-        hi_h, hi_l = c_hi_h[None, :, None], c_hi_l[None, :, None]
-        ge_lo = ~_lex_lt(numh_c, numl_c, lo_h, lo_l)
-        le_hi = ~_lex_lt(hi_h, hi_l, numh_c, numl_c)
-        gt_lo = _lex_lt(lo_h, lo_l, numh_c, numl_c)
-        lt_lo = _lex_lt(numh_c, numl_c, lo_h, lo_l)
-        eq_lo = _lex_eq(numh_c, numl_c, lo_h, lo_l)
-        in_range = ge_lo & le_hi
-        num_eq = numok_c & in_range
-        use_num = c_numfb[None, :, None] & numok_c
+            lo_h, lo_l = c_lo_h[None, :, None], c_lo_l[None, :, None]
+            hi_h, hi_l = c_hi_h[None, :, None], c_hi_l[None, :, None]
+            ge_lo = ~_lex_lt(numh_c, numl_c, lo_h, lo_l)
+            le_hi = ~_lex_lt(hi_h, hi_l, numh_c, numl_c)
+            gt_lo = _lex_lt(lo_h, lo_l, numh_c, numl_c)
+            lt_lo = _lex_lt(numh_c, numl_c, lo_h, lo_l)
+            eq_lo = _lex_eq(numh_c, numl_c, lo_h, lo_l)
+            in_range = ge_lo & le_hi
+            num_eq = numok_c & in_range
+            use_num = c_numfb[None, :, None] & numok_c
 
-        str_eq_ok = jnp.where(use_num, num_eq, stringy & str_hit)
+            str_eq_ok = jnp.where(use_num, num_eq, stringy & str_hit)
 
-        op = c_op[None, :, None]
-        value_ok = jnp.select(
-            [
-                op == CheckOp.STR_EQ,
-                op == CheckOp.STR_NE,
-                op == CheckOp.NUM_EQ,
-                op == CheckOp.NUM_NE,
-                op == CheckOp.NUM_GT,
-                op == CheckOp.NUM_GE,
-                op == CheckOp.NUM_LT,
-                op == CheckOp.NUM_LE,
-                op == CheckOp.NUM_IN_RANGE,
-                op == CheckOp.NUM_NOT_IN_RANGE,
-                op == CheckOp.BOOL_EQ,
-                op == CheckOp.IS_NULL,
-                op == CheckOp.EXISTS_OBJECT,
+            op = c_op[None, :, None]
+            value_ok = jnp.select(
+                [
+                    op == CheckOp.STR_EQ,
+                    op == CheckOp.STR_NE,
+                    op == CheckOp.NUM_EQ,
+                    op == CheckOp.NUM_NE,
+                    op == CheckOp.NUM_GT,
+                    op == CheckOp.NUM_GE,
+                    op == CheckOp.NUM_LT,
+                    op == CheckOp.NUM_LE,
+                    op == CheckOp.NUM_IN_RANGE,
+                    op == CheckOp.NUM_NOT_IN_RANGE,
+                    op == CheckOp.BOOL_EQ,
+                    op == CheckOp.IS_NULL,
+                    op == CheckOp.EXISTS_OBJECT,
+                    op == CheckOp.ABSENT,
+                ],
+                [
+                    str_eq_ok,
+                    stringy & ~str_eq_ok,
+                    numok_c & eq_lo,
+                    numok_c & ~eq_lo,
+                    numok_c & gt_lo,
+                    numok_c & ge_lo,
+                    numok_c & lt_lo,
+                    numok_c & ~gt_lo,
+                    num_eq,
+                    numok_c & ~in_range,
+                    (type_c == T_BOOL) & (bool_c == c_bool[None, :, None]),
+                    (type_c == T_NULL)
+                    | ((type_c == T_BOOL) & ~bool_c)
+                    | (numok_c & (numh_c == 0) & (numl_c == 0))
+                    | ((type_c == T_STR) & empty_str[jnp.maximum(sid_c, 0)] & has_sid),
+                    type_c == T_OBJ,
+                    jnp.ones_like(leaf_present),  # handled below
+                ],
+                default=jnp.zeros_like(leaf_present),
+            )
+
+            absent_ok = ~leaf_present & (
+                (first_absent & (c_guard[None, :, None] | leaf_bit)) != 0
+            )
+            slot_ok = jnp.where(
                 op == CheckOp.ABSENT,
-            ],
-            [
-                str_eq_ok,
-                stringy & ~str_eq_ok,
-                numok_c & eq_lo,
-                numok_c & ~eq_lo,
-                numok_c & gt_lo,
-                numok_c & ge_lo,
-                numok_c & lt_lo,
-                numok_c & ~gt_lo,
-                num_eq,
-                numok_c & ~in_range,
-                (type_c == T_BOOL) & (bool_c == c_bool[None, :, None]),
-                (type_c == T_NULL)
-                | ((type_c == T_BOOL) & ~bool_c)
-                | (numok_c & (numh_c == 0) & (numl_c == 0))
-                | ((type_c == T_STR) & empty_str[jnp.maximum(sid_c, 0)] & has_sid),
-                type_c == T_OBJ,
-                jnp.ones_like(leaf_present),  # handled below
-            ],
-            default=jnp.zeros_like(leaf_present),
-        )
+                absent_ok,
+                jnp.where(leaf_present, value_ok, guard_pass),
+            )
 
-        absent_ok = ~leaf_present & (
-            (first_absent & (c_guard[None, :, None] | leaf_bit)) != 0
-        )
-        slot_ok = jnp.where(
-            op == CheckOp.ABSENT,
-            absent_ok,
-            jnp.where(leaf_present, value_ok, guard_pass),
-        )
+            # ---- gates: per-element condition anchors in lists
+            gate_row_open = ~leaf_present | value_ok              # absent key opens
+            gate_rows = jnp.where(
+                c_is_gate[None, :, None],
+                gate_row_open | ~valid_c,
+                jnp.ones_like(gate_row_open),
+            )
+            # reduce gate rows -> gate_open [B, G, E0max]; gate rows have one
+            # wildcard so slot index == element index
+            gate_seg = jnp.where(c_is_gate, c_gate, n_gates)      # dump non-gates
+            gate_open = _segment_and(
+                gate_rows.swapaxes(0, 1).reshape(C, B * E), gate_seg, n_gates + 1
+            )[:n_gates].reshape(n_gates, B, E)
 
-        # ---- gates: per-element condition anchors in lists
-        gate_row_open = ~leaf_present | value_ok              # absent key opens
-        gate_rows = jnp.where(
-            c_is_gate[None, :, None],
-            gate_row_open | ~valid_c,
-            jnp.ones_like(gate_row_open),
-        )
-        # reduce gate rows -> gate_open [B, G, E0max]; gate rows have one
-        # wildcard so slot index == element index
-        gate_seg = jnp.where(c_is_gate, c_gate, n_gates)      # dump non-gates
-        gate_open = _segment_and(
-            gate_rows.swapaxes(0, 1).reshape(C, -1), gate_seg, n_gates + 1
-        )[:n_gates].reshape(n_gates, B, E)
+            # gather gate state for gated checks by top-level element index
+            has_gate = c_gate >= 0
+            gate_idx = jnp.maximum(c_gate, 0)
+            e0 = jnp.clip(elem0_c, 0, E - 1)
+            gate_for_slot = gate_open[gate_idx[None, :, None],
+                                      jnp.arange(B)[:, None, None], e0]
+            gate_skips = has_gate[None, :, None] & (elem0_c >= 0) & ~gate_for_slot
 
-        # gather gate state for gated checks by top-level element index
-        has_gate = c_gate >= 0
-        gate_idx = jnp.maximum(c_gate, 0)
-        e0 = jnp.clip(elem0_c, 0, E - 1)
-        gate_for_slot = gate_open[gate_idx[None, :, None],
-                                  jnp.arange(B)[:, None, None], e0]
-        gate_skips = has_gate[None, :, None] & (elem0_c >= 0) & ~gate_for_slot
+            slot_ok = jnp.where(gate_skips, True, slot_ok)
 
-        slot_ok = jnp.where(gate_skips, True, slot_ok)
+            # ---- stage 3: element reduction
+            and_ok = (slot_ok | ~valid_c).all(axis=2)
+            or_ok = (slot_ok & valid_c & leaf_present).any(axis=2)
+            check_ok = jnp.where(c_exist[None, :], or_ok, and_ok)   # [B, C]
 
-        # ---- stage 3: element reduction
-        and_ok = (slot_ok | ~valid_c).all(axis=2)
-        or_ok = (slot_ok & valid_c & leaf_present).any(axis=2)
-        check_ok = jnp.where(c_exist[None, :], or_ok, and_ok)   # [B, C]
+            # condition rows: key present & predicate failed -> skip; an absent
+            # ANCESTOR of the key is a plain pattern failure (the walk never
+            # reaches the anchor), not a skip
+            cond_bit = (1 << jnp.maximum(c_cond_depth, 0))[None, :, None]
+            cond_key_present = (mask_c & cond_bit) != 0
+            cond_fail_slot = cond_key_present & ~(leaf_present & value_ok) & valid_c
+            cond_fail = (c_is_cond[None, :] & cond_fail_slot.any(axis=2))
+            cond_chain_fail_slot = (first_absent != 0) & (first_absent < cond_bit) & valid_c
+            cond_chain_fail = (c_is_cond[None, :] & cond_chain_fail_slot.any(axis=2))
 
-        # condition rows: key present & predicate failed -> skip; an absent
-        # ANCESTOR of the key is a plain pattern failure (the walk never
-        # reaches the anchor), not a skip
-        cond_bit = (1 << jnp.maximum(c_cond_depth, 0))[None, :, None]
-        cond_key_present = (mask_c & cond_bit) != 0
-        cond_fail_slot = cond_key_present & ~(leaf_present & value_ok) & valid_c
-        cond_fail = (c_is_cond[None, :] & cond_fail_slot.any(axis=2))
-        cond_chain_fail_slot = (first_absent != 0) & (first_absent < cond_bit) & valid_c
-        cond_chain_fail = (c_is_cond[None, :] & cond_chain_fail_slot.any(axis=2))
+            # anchorMap tracking: tracked key never present while its parent was
+            # validated -> fail becomes error (common/anchorKey.go:94)
+            tr = c_track[None, :, None]
+            tr_parent = (mask_c >> jnp.maximum(tr - 1, 0)) & 1 > 0
+            tr_present = (mask_c >> jnp.maximum(tr, 0)) & 1 > 0
+            registered = ((c_track[None, :] >= 0)
+                          & (tr_parent & valid_c).any(axis=2))
+            anchor_missing = registered & ~(tr_present & valid_c).any(axis=2)
 
-        # anchorMap tracking: tracked key never present while its parent was
-        # validated -> fail becomes error (common/anchorKey.go:94)
-        tr = c_track[None, :, None]
-        tr_parent = (mask_c >> jnp.maximum(tr - 1, 0)) & 1 > 0
-        tr_present = (mask_c >> jnp.maximum(tr, 0)) & 1 > 0
-        registered = ((c_track[None, :] >= 0)
-                      & (tr_parent & valid_c).any(axis=2))
-        anchor_missing = registered & ~(tr_present & valid_c).any(axis=2)
+            # ---- stage 4: group / alt / rule reduction  (work in [C, B])
+            seg_ok = check_ok.T
+            # exclude gate + cond rows from the group AND (they are masks)
+            is_plain = ~(c_is_gate | c_is_cond)
+            plain_seg = jnp.where(is_plain, c_group, n_groups)
+            group_ok = _segment_and(jnp.where(is_plain[:, None], seg_ok, True),
+                                    plain_seg, n_groups + 1)[:n_groups]  # [G, B]
+            alt_ok = _segment_and(group_ok, group_alt, n_alts)            # [A, B]
 
-        # ---- stage 4: group / alt / rule reduction  (work in [C, B])
-        seg_ok = check_ok.T
-        # exclude gate + cond rows from the group AND (they are masks)
-        is_plain = ~(c_is_gate | c_is_cond)
-        plain_seg = jnp.where(is_plain, c_group, n_groups)
-        group_ok = _segment_and(jnp.where(is_plain[:, None], seg_ok, True),
-                                plain_seg, n_groups + 1)[:n_groups]  # [G, B]
-        alt_ok = _segment_and(group_ok, group_alt, n_alts)            # [A, B]
+            cond_seg = jnp.where(c_is_cond, c_alt, n_alts)
+            alt_skip = _segment_or(jnp.where(c_is_cond[:, None], cond_fail.T, False),
+                                   cond_seg, n_alts + 1)[:n_alts]
+            alt_chain_fail = _segment_or(
+                jnp.where(c_is_cond[:, None], cond_chain_fail.T, False),
+                cond_seg, n_alts + 1)[:n_alts]
+            alt_ok = alt_ok & ~alt_chain_fail
 
-        cond_seg = jnp.where(c_is_cond, c_alt, n_alts)
-        alt_skip = _segment_or(jnp.where(c_is_cond[:, None], cond_fail.T, False),
-                               cond_seg, n_alts + 1)[:n_alts]
-        alt_chain_fail = _segment_or(
-            jnp.where(c_is_cond[:, None], cond_chain_fail.T, False),
-            cond_seg, n_alts + 1)[:n_alts]
-        alt_ok = alt_ok & ~alt_chain_fail
+            track_seg = jnp.where(c_track >= 0, c_alt, n_alts)
+            alt_missing = _segment_or(
+                jnp.where((c_track >= 0)[:, None], anchor_missing.T, False),
+                track_seg, n_alts + 1,
+            )[:n_alts]
 
-        track_seg = jnp.where(c_track >= 0, c_alt, n_alts)
-        alt_missing = _segment_or(
-            jnp.where((c_track >= 0)[:, None], anchor_missing.T, False),
-            track_seg, n_alts + 1,
-        )[:n_alts]
+            # per-alt verdict
+            alt_verdict = jnp.where(
+                alt_skip, V_SKIP,
+                jnp.where(alt_ok, V_PASS,
+                          jnp.where(alt_missing, V_ERROR, V_FAIL)))
 
-        # per-alt verdict
-        alt_verdict = jnp.where(
-            alt_skip, V_SKIP,
-            jnp.where(alt_ok, V_PASS,
-                      jnp.where(alt_missing, V_ERROR, V_FAIL)))
+            # single-pattern rules: verdict = the alt verdict.
+            # anyPattern rules: any pass -> pass, else fail (skips/errors are
+            # folded into the failure list, validation.go:448-480)
+            alt_pass = alt_verdict == V_PASS
+            rule_pass = _segment_or(alt_pass, alt_rule, n_rules)
+            single_verdict = jax.ops.segment_max(
+                jnp.where(alt_is_multi[:, None], 0, alt_verdict),
+                alt_rule, num_segments=n_rules)
+            multi = jax.ops.segment_max(alt_is_multi[:, None].astype(jnp.int32) *
+                                        jnp.ones((n_alts, B), jnp.int32),
+                                        alt_rule, num_segments=n_rules) > 0
+            verdict = jnp.where(
+                multi, jnp.where(rule_pass, V_PASS, V_FAIL), single_verdict
+            ).T                                                    # [B, R]
 
-        # single-pattern rules: verdict = the alt verdict.
-        # anyPattern rules: any pass -> pass, else fail (skips/errors are
-        # folded into the failure list, validation.go:448-480)
-        alt_pass = alt_verdict == V_PASS
-        rule_pass = _segment_or(alt_pass, alt_rule, n_rules)
-        single_verdict = jax.ops.segment_max(
-            jnp.where(alt_is_multi[:, None], 0, alt_verdict),
-            alt_rule, num_segments=n_rules)
-        multi = jax.ops.segment_max(alt_is_multi[:, None].astype(jnp.int32) *
-                                    jnp.ones((n_alts, B), jnp.int32),
-                                    alt_rule, num_segments=n_rules) > 0
-        verdict = jnp.where(
-            multi, jnp.where(rule_pass, V_PASS, V_FAIL), single_verdict
-        ).T.astype(jnp.int8)                                   # [B, R]
+            # gate rows whose key is absent in some element reproduce the
+            # reference's first-failing-element anchorMap order dependency
+            # (validateArrayOfMaps stops at the first non-conditional error);
+            # a failing verdict there is resolved by the CPU oracle instead
+            gate_key_absent = (c_is_gate[None, :] &
+                               (~leaf_present & valid_c & (elem0_c >= 0)).any(axis=2))
+            rule_seg = jnp.where(c_is_gate, jnp.asarray(tensors.chk_rule), n_rules)
+            rule_gate_uncertain = _segment_or(
+                gate_key_absent.T, rule_seg, n_rules + 1)[:n_rules].T  # [B, R]
+            verdict = jnp.where(
+                rule_gate_uncertain & ((verdict == V_FAIL) | (verdict == V_ERROR)),
+                V_HOST, verdict)
+        else:
+            # no pattern check rows at all (e.g. a deny-only policy
+            # set): rules with alts pass vacuously (an empty pattern
+            # map matches everything); everything else is composed in
+            # stage 6. Computed without empty-operand scatters, which
+            # abort the TPU backend (libtpu scatter_emitter check).
+            verdict = jnp.broadcast_to(
+                jnp.where(covered[None, :], V_PASS, V_NOT_APPLICABLE),
+                (B, n_rules)).astype(jnp.int32)
 
-        # gate rows whose key is absent in some element reproduce the
-        # reference's first-failing-element anchorMap order dependency
-        # (validateArrayOfMaps stops at the first non-conditional error);
-        # a failing verdict there is resolved by the CPU oracle instead
-        gate_key_absent = (c_is_gate[None, :] &
-                           (~leaf_present & valid_c & (elem0_c >= 0)).any(axis=2))
-        rule_seg = jnp.where(c_is_gate, jnp.asarray(tensors.chk_rule), n_rules)
-        rule_gate_uncertain = _segment_or(
-            gate_key_absent.T, rule_seg, n_rules + 1)[:n_rules].T  # [B, R]
+        # ---- stage 5: aux programs (match/exclude/preconditions/deny)
+        if X:
+            def gx(arr):
+                # aux paths are wildcard-free -> exactly one slot (e=0)
+                return jnp.take(arr, x_path, axis=1)[:, :, 0]
 
-        # rules with no device rows (host-only) or no alts at all
-        covered = jnp.zeros(n_rules, bool).at[alt_rule].set(True)
+            maskx = gx(mask).astype(jnp.int32)
+            typex = gx(type_tag).astype(jnp.int32)
+            sidx = gx(str_id)
+            nhx, nlx = gx(num_hi), gx(num_lo)
+            nokx = gx(num_ok)
+            nplainx = gx(num_plain)
+            nintx = gx(num_int)
+            dhx, dlx = gx(dur_hi), gx(dur_lo)
+            durokx = gx(dur_ok)
+            duranyx = gx(dur_any)
+            boolx = gx(bool_val)
+            nbrkx = gx(null_break)
+
+            leafb = (1 << x_plen)[None, :]
+            wantb = (leafb << 1) - 2
+            presx = ((~maskx) & wantb) == 0
+            # a chain broken at a non-map node resolves to null (not an
+            # unresolved variable): conditions see a null key -> false,
+            # while a missing map key is a true absence (precondition ""
+            # substitute / deny substitution error)
+            nullx = (presx & (typex == T_NULL)) | (~presx & nbrkx)
+            absx = ~presx & ~nbrkx
+
+            hasid = sidx >= 0
+            sid0 = jnp.maximum(sidx, 0)
+            globx = match_nv[x_nfa[None, :], sid0] & hasid & x_has_nfa[None, :]
+            keyglob = str_has_glob[sid0] & hasid
+
+            strk = typex == T_STR
+            numk = typex == T_NUM
+            boolk = typex == T_BOOL
+            listk = typex == T_LIST
+
+            qh, ql = x_q_h[None, :], x_q_l[None, :]
+            sh, sl = x_s_h[None, :], x_s_l[None, :]
+            n_lt_q = _lex_lt(nhx, nlx, qh, ql)
+            n_gt_q = _lex_lt(qh, ql, nhx, nlx)
+            n_eq_q = _lex_eq(nhx, nlx, qh, ql)
+            n_lt_s = _lex_lt(nhx, nlx, sh, sl)
+            n_gt_s = _lex_lt(sh, sl, nhx, nlx)
+            d_lt_s = _lex_lt(dhx, dlx, sh, sl)
+            d_gt_s = _lex_lt(sh, sl, dhx, dlx)
+            d_eq_s = _lex_eq(dhx, dlx, sh, sl)
+
+            o_str = x_o_str[None, :]
+            o_num = x_o_num[None, :]
+            o_dur = x_o_dur[None, :]
+            o_float = x_o_float[None, :]
+            o_int = x_o_int[None, :]
+            o_quant = x_o_quant[None, :]
+
+            # NOTE: these predicate trees are written in pure boolean
+            # algebra (no nested jnp.where chains) — the TPU backend
+            # miscompiles fused where-on-bool chains here (verified with
+            # tests/manual_tpu_fusion_check.py); and/or/not lowers cleanly.
+
+            # Equals (operator/equal.go; engine/operators._equal):
+            #   bool key: operand must be bool and equal
+            #   number key: micro-unit equality; a string operand must parse
+            #     the way the key's Go type requires (Atoi for int keys,
+            #     ParseFloat for float keys)
+            #   string key: duration pair first, then quantity-vs-quantity,
+            #   then the operand is the wildcard pattern over the key
+            dur_pair = durokx & (o_dur | o_num)       # string-key dur pair
+            ceq = (
+                (boolk & x_o_bool[None, :] & (boolx == x_obool[None, :]))
+                | (numk & nokx & o_quant & n_eq_q
+                   & (o_num | (o_str & ((nintx & o_int)
+                                        | (~nintx & o_float)))))
+                | (strk & ((dur_pair & d_eq_s)
+                           | (~dur_pair & nokx & o_str & o_quant & n_eq_q)
+                           | (~dur_pair & ~nokx & o_str & globx)))
+            )
+
+            def rel4(base, lt, gt):
+                opx_ = x_op[None, :]
+                return (((opx_ == base) & gt)
+                        | ((opx_ == base + 1) & ~lt)
+                        | ((opx_ == base + 2) & lt)
+                        | ((opx_ == base + 3) & ~gt))
+
+            cmp_q = rel4(int(AuxOp.CGT), n_lt_q, n_gt_q)
+            cmp_ns = rel4(int(AuxOp.CGT), n_lt_s, n_gt_s)
+            cmp_ds = rel4(int(AuxOp.CGT), d_lt_s, d_gt_s)
+            # GreaterThan family (variables/operator/numeric.go): duration
+            # pair, then float key, then quantity-vs-quantity-string
+            numkey_cmp = ((o_num & cmp_q)
+                          | (~o_num & o_str & o_dur & cmp_ns)
+                          | (~o_num & o_str & ~o_dur & o_float & cmp_q))
+            cnum = (
+                (numk & numkey_cmp)
+                | (strk & dur_pair & cmp_ds)
+                | (strk & ~dur_pair & nplainx & numkey_cmp)
+                | (strk & ~dur_pair & ~nplainx & nokx
+                   & o_str & o_quant & cmp_q)
+            )
+            # Duration* family (variables/operator/duration.go): both sides
+            # as seconds; numbers are seconds, strings must Go-parse
+            dnum = rel4(int(AuxOp.DGT), n_lt_s, n_gt_s)
+            ddur = rel4(int(AuxOp.DGT), d_lt_s, d_gt_s)
+            cdur = (numk & dnum) | (strk & duranyx & ddur)
+
+            # In-family rows: the NFA row is literal(item) for CIN_ITEM
+            # (in.go:62 keyExistsInArray — the key is the wildcard pattern,
+            # exact on device, host lane for metachar keys) and
+            # glob(value) for CIN_GLOB
+            in_keyish = strk | (numk & x_allow_num[None, :] & nintx)
+            cin = in_keyish & globx
+
+            opx = x_op[None, :]
+            op_val = (
+                ((opx == int(AuxOp.TRUE)))
+                | ((opx == int(AuxOp.GLOB)) & (strk | (numk & nintx)) & globx)
+                | ((opx == int(AuxOp.EXISTS)) & presx)
+                | ((opx == int(AuxOp.NOT_EXISTS)) & ~presx)
+                | ((opx == int(AuxOp.CEQ)) & ceq)
+                | (((opx == int(AuxOp.CIN_ITEM))
+                    | (opx == int(AuxOp.CIN_GLOB))) & cin)
+                | ((opx >= int(AuxOp.CGT)) & (opx <= int(AuxOp.CLE)) & cnum)
+                | ((opx >= int(AuxOp.DGT)) & (opx <= int(AuxOp.DLE)) & cdur)
+            )
+
+            # absence semantics differ by row class: match/exclude rows
+            # treat null like absent (utils.go reads fields with or-""),
+            # condition rows see a null key (-> false) vs a missing key
+            # (-> the precomputed ""-substitution result)
+            absres = x_absent[None, :]
+            is_exist_op = ((opx == int(AuxOp.EXISTS))
+                           | (opx == int(AuxOp.NOT_EXISTS)))
+            pres_nonnull = presx & (typex != T_NULL)
+            match_val = ((is_exist_op & op_val)
+                         | (~is_exist_op & pres_nonnull & op_val)
+                         | (~is_exist_op & ~pres_nonnull & absres))
+            cond_val = ~nullx & ((presx & op_val) | (~presx & absres))
+            is_mk = x_is_match_klass[None, :]
+            has_p = x_has_path[None, :]
+            rowv = (is_mk & match_val) | (~is_mk & cond_val)
+            rowv = (has_p & rowv) | (~has_p & op_val)
+            kind_ok = (x_kind[None, :] < 0) | (kind_id[:, None] == x_kind[None, :])
+            rowv = rowv & kind_ok
+            # FUSION FENCE — the TPU backend miscompiles the aux predicate
+            # tree when it fuses into the segment reductions (wrong deny /
+            # precondition verdicts; reproduced deterministically, see
+            # tests/manual_tpu_fusion_check.py). Materializing the [B, X]
+            # row values here keeps the bad fusion from forming; the cost
+            # is one small boolean tensor per batch.
+            rowv = jax.lax.optimization_barrier(rowv)
+
+            # rows the device cannot score faithfully -> host lane:
+            # list-valued keys (set-containment, in.go:110), float keys in
+            # In rows (fmt.Sprint formatting differs from the equality
+            # interning), metachar keys acting as patterns, non-stringy
+            # values under a match glob. A kind-gated row that missed its
+            # kind is definitively false, never uncertain. Match-row and
+            # condition-row uncertainty compose differently in stage 6: a
+            # certain match miss makes condition uncertainty irrelevant.
+            is_cinop = (opx == int(AuxOp.CIN_ITEM)) | (opx == int(AuxOp.CIN_GLOB))
+            unc = is_cinop & (
+                listk
+                | (numk & x_allow_num[None, :] & ~nintx)
+                | (x_key_pat[None, :] & strk & keyglob))
+            unc = unc | ((opx == int(AuxOp.GLOB)) & presx
+                         & ~(strk | (numk & nintx) | (typex == T_NULL)))
+            unc = unc & kind_ok
+            unc_m = unc & is_mk
+            unc_c = unc & ~is_mk
+            match_unc = _segment_or(unc_m.T, x_rule, n_rules).T    # [B, R]
+            cond_unc = _segment_or(unc_c.T, x_rule, n_rules).T     # [B, R]
+
+            # deny rows whose key is a missing map key: the reference's
+            # substitution fails -> rule ERROR (validation.go:299
+            # validateDeny / vars.go NotFoundVariableErr)
+            errx = x_err[None, :] & absx & x_has_path[None, :]
+            deny_err = _segment_or(errx.T, x_rule, n_rules).T      # [B, R]
+
+            # group OR -> XOR negate
+            grp0 = _segment_or(rowv.T, x_group, n_axg)
+            neg = axg_negate[:, None]
+            grp = (neg & ~grp0) | (~neg & grp0)
+
+            # match/exclude: groups AND within a filter
+            has_filt = axg_filt >= 0
+            filt_seg = jnp.where(has_filt, axg_filt, n_axf)
+            filt_ok = _segment_and(
+                ~has_filt[:, None] | grp, filt_seg, n_axf + 1
+            )[:n_axf]                                              # [FX, B]
+
+            # filters -> rule: match.any = OR, match.all / single = AND;
+            # exclude.any = OR, exclude.all = AND (utils.go:265-337)
+            is_m = ~axf_is_ex
+            mseg = jnp.where(is_m, axf_rule, n_rules)
+            m_or = _segment_or(is_m[:, None] & filt_ok,
+                               mseg, n_rules + 1)[:n_rules]
+            m_and = _segment_and(~is_m[:, None] | filt_ok,
+                                 mseg, n_rules + 1)[:n_rules]
+            m_any = rule_match_any[:, None]
+            match_ok = (m_any & m_or) | (~m_any & m_and)
+            match_ok = match_ok | ~rule_has_match[:, None]
+            eseg = jnp.where(axf_is_ex, axf_rule, n_rules)
+            e_or = _segment_or(axf_is_ex[:, None] & filt_ok,
+                               eseg, n_rules + 1)[:n_rules]
+            e_and = _segment_and(~axf_is_ex[:, None] | filt_ok,
+                                 eseg, n_rules + 1)[:n_rules]
+            e_all = rule_exclude_all[:, None]
+            exclude_hit = (((e_all & e_and) | (~e_all & e_or))
+                           & rule_has_exclude[:, None])
+            applicable_aux = (match_ok & ~exclude_hit).T           # [B, R]
+
+            # conditions: AND(all-block) AND (OR(any-block) if any present)
+            # (variables/evaluate.go:21 evaluateAnyAllConditions)
+            def cond_reduce(klass_const, has_any_col):
+                isk = axg_klass == klass_const
+                in_all = isk & ~axg_any
+                in_any = isk & axg_any
+                all_seg = jnp.where(in_all, axg_rule, n_rules)
+                all_ok = _segment_and(
+                    ~in_all[:, None] | grp, all_seg,
+                    n_rules + 1)[:n_rules]
+                any_seg = jnp.where(in_any, axg_rule, n_rules)
+                any_ok = _segment_or(
+                    in_any[:, None] & grp, any_seg,
+                    n_rules + 1)[:n_rules]
+                return (all_ok & (any_ok | ~has_any_col[:, None])).T
+
+            precond_ok = cond_reduce(AUX_PRECOND, rule_precond_any)
+            deny_match = cond_reduce(AUX_DENY, rule_deny_any)
+        else:
+            applicable_aux = jnp.ones((B, n_rules), bool)
+            precond_ok = jnp.ones((B, n_rules), bool)
+            deny_match = jnp.zeros((B, n_rules), bool)
+            deny_err = jnp.zeros((B, n_rules), bool)
+            match_unc = jnp.zeros((B, n_rules), bool)
+            cond_unc = jnp.zeros((B, n_rules), bool)
+
+        # ---- stage 6: verdict composition
+        deny_v = jnp.where(deny_err, V_ERROR,
+                           jnp.where(deny_match, V_FAIL, V_PASS))
+        verdict = jnp.where(rule_deny[None, :], deny_v, verdict)
+
+        # pattern rules with no device rows at all (host-only handled below)
+        verdict = jnp.where((~covered & ~rule_host & ~rule_deny)[None, :],
+                            V_NOT_APPLICABLE, verdict)
+
+        # failed preconditions -> SKIP; uncertain condition rows -> HOST;
+        # then a CERTAIN match miss / exclude hit -> NOT_APPLICABLE (a
+        # non-matching rule produces no rule response, making condition
+        # uncertainty irrelevant); finally uncertain match rows -> HOST
+        # (the applicability determination itself is unreliable)
+        verdict = jnp.where(precond_ok, verdict, V_SKIP)
+        verdict = jnp.where(cond_unc & ~rule_host[None, :], V_HOST, verdict)
+        verdict = jnp.where(applicable_aux | rule_host[None, :],
+                            verdict, V_NOT_APPLICABLE)
+        verdict = jnp.where(match_unc & ~rule_host[None, :], V_HOST, verdict)
+
         verdict = jnp.where(rule_host[None, :], V_HOST, verdict)
-        verdict = jnp.where((~covered & ~rule_host)[None, :], V_NOT_APPLICABLE, verdict)
-
-        # kind prefilter: resource kind must be in the rule's kind set
+        # legacy kind prefilter gates host-lane rules only (device rules
+        # carry their full match program as aux rows)
         kind_hit = (rule_kind_ids[None, :, :] == kind_id[:, None, None]).any(-1)
-        applicable = kind_hit | rule_all_kinds[None, :]
-        verdict = jnp.where(applicable, verdict, V_NOT_APPLICABLE)
-
-        verdict = jnp.where(
-            rule_gate_uncertain & ((verdict == V_FAIL) | (verdict == V_ERROR)),
-            V_HOST, verdict)
+        applicable_host = kind_hit | rule_all_kinds[None, :]
+        verdict = jnp.where(rule_host[None, :] & ~applicable_host,
+                            V_NOT_APPLICABLE, verdict)
 
         # resources flagged by the flattener take the host lane entirely
-        verdict = jnp.where(host_flag[:, None] & (verdict != V_NOT_APPLICABLE),
-                            V_HOST, verdict)
-        return verdict
+        # (their aux program may be unreliable too, so HOST overrides NA)
+        verdict = jnp.where(host_flag[:, None], V_HOST, verdict)
+        # mesh-pad rows -> NOT_APPLICABLE (explicit flag: a real resource
+        # may have zero valid slots when every path crosses an empty array)
+        verdict = jnp.where(live[:, None], verdict, V_NOT_APPLICABLE)
+        if _DEBUG is not None and X:
+            return verdict.astype(jnp.int8), dict(
+                presx=presx, globx=globx, op_val=op_val, rowv=rowv, grp=grp,
+                deny_match=deny_match, precond_ok=precond_ok,
+                match_ok=match_ok, applicable_aux=applicable_aux, ceq=ceq,
+                deny_err=deny_err, match_unc=match_unc, cond_unc=cond_unc)
+        return verdict.astype(jnp.int8)
 
     return jax.jit(evaluate) if jit else evaluate

@@ -11,13 +11,15 @@ the ReportChangeRequest fan-in (/root/reference/pkg/policyreport).
 
 from __future__ import annotations
 
+from dataclasses import replace
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.engine import CompiledPolicySet
-from ..models.flatten import FlatBatch
+from ..models.flatten import BATCH_ARRAYS, DICT_ARRAYS, FlatBatch
 from ..ops.eval import V_FAIL, V_HOST, V_PASS
 
 
@@ -28,35 +30,22 @@ def make_mesh(devices=None, axis: str = "data") -> Mesh:
 
 def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
     """Pad the batch axis to a multiple of the mesh size. Padded rows carry
-    kind_id=-1 so every rule reports NOT_APPLICABLE for them."""
+    no valid slots, so the kernel reports NOT_APPLICABLE for them. Derives
+    the field list from flatten.BATCH_ARRAYS so a FlatBatch schema change
+    cannot silently desynchronize the mesh path again."""
     b = batch.n
     padded = (b + multiple - 1) // multiple * multiple
     if padded == b:
         return batch, b
     pad = padded - b
 
-    def pb(x):
+    updates = {"n": padded}
+    for name in BATCH_ARRAYS + ("num_val",):
+        x = getattr(batch, name)
         width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return np.pad(x, width)
-
-    return FlatBatch(
-        n=padded, e=batch.e,
-        mask=pb(batch.mask), slot_valid=pb(batch.slot_valid),
-        type_tag=pb(batch.type_tag), str_id=pb(batch.str_id),
-        num_val=pb(batch.num_val), num_hi=pb(batch.num_hi),
-        num_lo=pb(batch.num_lo), num_ok=pb(batch.num_ok),
-        bool_val=pb(batch.bool_val), elem0=pb(batch.elem0),
-        kind_id=np.pad(batch.kind_id, (0, pad), constant_values=-1),
-        host_flag=np.pad(batch.host_flag, (0, pad)),
-        str_bytes=batch.str_bytes, str_len=batch.str_len,
-        strings=batch.strings,
-    ), b
-
-
-def _batch_arrays(batch: FlatBatch) -> tuple:
-    return (batch.mask, batch.slot_valid, batch.type_tag, batch.str_id,
-            batch.num_hi, batch.num_lo, batch.num_ok, batch.bool_val,
-            batch.elem0, batch.kind_id, batch.host_flag)
+        fill = -1 if name == "kind_id" else 0
+        updates[name] = np.pad(x, width, constant_values=fill)
+    return replace(batch, **updates), b
 
 
 def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
@@ -69,11 +58,8 @@ def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
     data = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
 
-    def step(mask, slot_valid, type_tag, str_id, num_hi, num_lo, num_ok,
-             bool_val, elem0, kind_id, host_flag, str_bytes, str_len):
-        verdict = base(mask, slot_valid, type_tag, str_id, num_hi, num_lo,
-                       num_ok, bool_val, elem0, kind_id, host_flag,
-                       str_bytes, str_len)
+    def step(*args):
+        verdict = base(*args)
         # report aggregation: per-rule pass/fail counts across the whole
         # sharded batch -> all-reduce over ICI
         fails = jnp.sum(verdict == V_FAIL, axis=0)
@@ -82,7 +68,8 @@ def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
 
     return jax.jit(
         step,
-        in_shardings=tuple([data] * 11 + [repl, repl]),
+        in_shardings=tuple([data] * len(BATCH_ARRAYS)
+                           + [repl] * len(DICT_ARRAYS)),
         out_shardings=(data, repl, repl),
     )
 
@@ -101,8 +88,7 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     batch = cps.flatten(resources)
     batch, n = pad_batch(batch, mesh.devices.size)
     fn = sharded_eval_fn(cps, mesh, axis)
-    verdict, fails, passes = fn(*_batch_arrays(batch), batch.str_bytes,
-                                batch.str_len)
+    verdict, fails, passes = fn(*batch.device_args())
     verdicts = np.array(verdict)[:n]
     if (verdicts == V_HOST).any():
         verdicts = cps.resolve_host_cells(resources, verdicts)

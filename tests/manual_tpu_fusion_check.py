@@ -1,0 +1,110 @@
+"""Manual check for the TPU XLA fusion bug in the aux stage (round 3).
+
+The TPU backend miscompiles the aux predicate tree when it fuses into the
+segment reductions: condition rows read False (or deny verdicts flip to
+PASS) under jit while eager and the CPU oracle agree. ops/eval.py carries
+an optimization_barrier fence on the aux row values; this script proves
+the fence holds on the accelerator backend for the two known-miscompiling
+fixtures.
+
+Run on the TPU backend: `python tests/manual_tpu_fusion_check.py` (from
+anywhere — the script bootstraps sys.path). Exit 0 = every jitted verdict
+matrix matches eager; exit 1 = a miscompile reproduced. Kept as a manual
+script (not collected by pytest) because the CI conftest forces the CPU
+backend where the fusion bug does not reproduce.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from kyverno_tpu.api.load import load_policy  # noqa: E402
+from kyverno_tpu.models import CompiledPolicySet  # noqa: E402
+import kyverno_tpu.ops.eval as ev  # noqa: E402
+
+# fixture 1: deny + precondition mixed with a pattern rule — originally
+# made every condition row read False under jit
+FIX1_POLICIES = [
+    {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+     "metadata": {"name": "deny-host-ns"},
+     "spec": {"rules": [{"name": "deny-privileged-ns",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"deny": {"conditions": {"any": [
+            {"key": "{{request.object.metadata.namespace}}",
+             "operator": "Equals", "value": "kube-system"}]}}}}]}},
+    {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+     "metadata": {"name": "precond"},
+     "spec": {"rules": [{"name": "tagged-only",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "preconditions": {"all": [
+            {"key": "{{request.object.metadata.labels.tier}}",
+             "operator": "Equals", "value": "web"}]},
+        "validate": {"pattern": {"spec": {"containers": [
+            {"image": "!*:latest"}]}}}}]}},
+]
+FIX1_RESOURCES = [
+    {"apiVersion": "v1", "kind": "Pod",
+     "metadata": {"name": "a", "namespace": "kube-system"},
+     "spec": {"containers": [{"name": "c", "image": "nginx:1.21"}]}},
+    {"apiVersion": "v1", "kind": "Pod",
+     "metadata": {"name": "c", "namespace": "default",
+                  "labels": {"tier": "web"}},
+     "spec": {"containers": [{"name": "c", "image": "nginx:latest"}]}},
+]
+
+# fixture 2: deny-only set with bool operand, absent-key ERROR lane, and a
+# scalar (null-break) spec — flipped a FAIL to PASS under jit even after
+# the boolean-algebra rewrite
+FIX2_POLICIES = [
+    {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+     "metadata": {"name": "a"},
+     "spec": {"rules": [{"name": "a",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"deny": {"conditions": {"any": [
+            {"key": "{{request.object.spec.hostNetwork}}",
+             "operator": "Equals", "value": True}]}}}}]}},
+    {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+     "metadata": {"name": "b"},
+     "spec": {"rules": [{"name": "b",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"deny": {"conditions": {"any": [
+            {"key": "{{request.object.spec.nosuch}}",
+             "operator": "Equals", "value": "x"}]}}}}]}},
+]
+FIX2_RESOURCES = [
+    {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p1"},
+     "spec": "oops"},
+    {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p2"},
+     "spec": {"hostNetwork": True}},
+    {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "p3"},
+     "spec": {}},
+]
+
+# compatibility aliases (older revisions exposed a single fixture pair)
+POLICIES = FIX1_POLICIES
+RESOURCES = FIX1_RESOURCES
+
+
+def check(name, policies, resources) -> bool:
+    cps = CompiledPolicySet([load_policy(p) for p in policies])
+    b = cps.flatten(resources)
+    eager = np.array(ev.build_eval_fn(cps.tensors, jit=False)(*b.device_args()))
+    jitted = np.array(ev.build_eval_fn(cps.tensors, jit=True)(*b.device_args()))
+    if np.array_equal(eager, jitted):
+        print(f"{name} OK: jit matches eager: {jitted.tolist()}")
+        return True
+    print(f"{name} MISCOMPILE: eager {eager.tolist()} jit {jitted.tolist()}")
+    return False
+
+
+def main() -> int:
+    ok = check("fixture-1", FIX1_POLICIES, FIX1_RESOURCES)
+    ok &= check("fixture-2", FIX2_POLICIES, FIX2_RESOURCES)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
