@@ -108,7 +108,12 @@ class CompiledPolicySet:
         return verdicts
 
     def _oracle_verdicts(self, resource: dict, rule_rows: list[int]) -> dict[int, int]:
-        """Run the CPU oracle for specific rules of one resource."""
+        """Run the CPU oracle for specific rules of one resource.
+
+        Namespaced Policy objects only apply inside their own namespace —
+        the reference enforces this in the policy cache lookup
+        (pkg/policycache/cache.go:89), not in the engine, so the gate is
+        applied here to mirror what the device match program compiles."""
         out: dict[int, int] = {}
         by_policy: dict[int, list[RuleRef]] = {}
         for r in rule_rows:
@@ -116,6 +121,11 @@ class CompiledPolicySet:
             by_policy.setdefault(id(ref.policy), []).append(ref)
         for refs in by_policy.values():
             policy = refs[0].policy
+            pns = getattr(policy, "namespace", "")
+            if pns and ((resource.get("metadata") or {}).get("namespace") or "") != pns:
+                for ref in refs:
+                    out[ref.rule_index] = Verdict.NOT_APPLICABLE
+                continue
             jctx = Context()
             jctx.add_resource(resource)
             resp = oracle_validate(
