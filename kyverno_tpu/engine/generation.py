@@ -62,13 +62,15 @@ def _filter_rule(rule, policy_ctx: PolicyContext) -> RuleResponse | None:
     if not rule.has_generate():
         return None
 
+    # policy-namespace gate applied engine-side (see validation._matches)
+    ns = policy_ctx.policy.namespace if policy_ctx.policy is not None else ""
     ok, _ = matches_resource_description(
         policy_ctx.new_resource,
         rule,
         policy_ctx.admission_info,
         policy_ctx.exclude_group_role,
         policy_ctx.namespace_labels,
-        "",
+        ns,
     )
     if not ok:
         # old resource matching means the GR must be cleaned up -> FAIL row
@@ -78,7 +80,7 @@ def _filter_rule(rule, policy_ctx: PolicyContext) -> RuleResponse | None:
             policy_ctx.admission_info,
             policy_ctx.exclude_group_role,
             policy_ctx.namespace_labels,
-            "",
+            ns,
         )
         if policy_ctx.old_resource and old_ok:
             return rule_response(rule, RuleType.GENERATION, "", RuleStatus.FAIL)

@@ -86,14 +86,22 @@ def _validate_resource(ctx: PolicyContext) -> EngineResponse:
 
 
 def _matches(rule, ctx: PolicyContext) -> bool:
-    """validation.go:383 matches: new OR old resource satisfies match/exclude."""
+    """validation.go:383 matches: new OR old resource satisfies match/exclude.
+
+    The reference passes "" for policyNamespace here (validation.go:384)
+    because its webhook always pre-filters namespaced policies through the
+    policy cache (policycache/cache.go:89). This engine is also entered with
+    unfiltered policy sets (CompiledPolicySet, CLI), so the namespace gate of
+    utils.go:272 is applied here, as the reference's mutation path does
+    (mutation.go:63)."""
+    ns = ctx.policy.namespace if ctx.policy is not None else ""
     ok, _ = matches_resource_description(
         ctx.new_resource,
         rule,
         ctx.admission_info,
         ctx.exclude_group_role,
         ctx.namespace_labels,
-        "",
+        ns,
     )
     if ok:
         return True
@@ -104,7 +112,7 @@ def _matches(rule, ctx: PolicyContext) -> bool:
             ctx.admission_info,
             ctx.exclude_group_role,
             ctx.namespace_labels,
-            "",
+            ns,
         )
         if ok:
             return True

@@ -110,10 +110,9 @@ class CompiledPolicySet:
     def _oracle_verdicts(self, resource: dict, rule_rows: list[int]) -> dict[int, int]:
         """Run the CPU oracle for specific rules of one resource.
 
-        Namespaced Policy objects only apply inside their own namespace —
-        the reference enforces this in the policy cache lookup
-        (pkg/policycache/cache.go:89), not in the engine, so the gate is
-        applied here to mirror what the device match program compiles."""
+        Namespaced Policy objects only apply inside their own namespace;
+        oracle_validate applies that gate engine-side (validation._matches,
+        utils.go:272 semantics), matching the device match program."""
         out: dict[int, int] = {}
         by_policy: dict[int, list[RuleRef]] = {}
         for r in rule_rows:
@@ -121,11 +120,6 @@ class CompiledPolicySet:
             by_policy.setdefault(id(ref.policy), []).append(ref)
         for refs in by_policy.values():
             policy = refs[0].policy
-            pns = getattr(policy, "namespace", "")
-            if pns and ((resource.get("metadata") or {}).get("namespace") or "") != pns:
-                for ref in refs:
-                    out[ref.rule_index] = Verdict.NOT_APPLICABLE
-                continue
             jctx = Context()
             jctx.add_resource(resource)
             resp = oracle_validate(

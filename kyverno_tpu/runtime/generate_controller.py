@@ -110,7 +110,8 @@ class GenerateController:
         for rule in policy.spec.rules:
             if not rule.has_generate():
                 continue
-            ok, _ = matches_resource_description(trigger, rule)
+            ok, _ = matches_resource_description(
+                trigger, rule, policy_namespace=policy.namespace)
             if not ok:
                 continue
             try:
