@@ -75,7 +75,9 @@ class CompiledPolicySet:
         return self._eval_fn
 
     def flatten(self, resources: list[dict]) -> FlatBatch:
-        return flatten_batch(resources, self.tensors)
+        from .native_flatten import flatten_batch_fast
+
+        return flatten_batch_fast(resources, self.tensors)
 
     def evaluate_device(self, batch: FlatBatch) -> np.ndarray:
         """Device verdicts [B, R] (host-lane rows = Verdict.HOST)."""

@@ -111,10 +111,16 @@ def _value_to_micro(value) -> int | None:
     try:
         if isinstance(value, bool):
             return None
-        if isinstance(value, (int, float)):
+        if isinstance(value, float):
+            # decode the shortest decimal repr (the JSON token) rather than
+            # the exact binary double: "0.1" means 100000 micro, and repr
+            # artifacts like 0.30000000000000004 take the host lane — the
+            # same decision the native flattener makes from the token text
+            micro = parse_quantity(repr(value)) * NUM_SCALE
+        elif isinstance(value, int):
             from fractions import Fraction
 
-            micro = Fraction(value).limit_denominator(10**12) * NUM_SCALE
+            micro = Fraction(value) * NUM_SCALE
         elif isinstance(value, str):
             micro = parse_quantity(value) * NUM_SCALE
         else:
@@ -124,6 +130,23 @@ def _value_to_micro(value) -> int | None:
     if micro.denominator != 1 or abs(micro.numerator) > NUM_MAX:
         return None
     return int(micro)
+
+
+def _needs_host_parse(s: str) -> bool:
+    """True when the string could parse differently under unicode-aware
+    rules (str.strip(), regex \\d, float()) than under the ASCII grammar
+    the device lanes and the native flattener implement: any unicode
+    whitespace/decimal digit, or the \\x1c-\\x1f controls str.isspace()
+    accepts. Such leaves route the resource to the CPU oracle."""
+    import unicodedata
+
+    for ch in s:
+        o = ord(ch)
+        if 0x1C <= o <= 0x1F:
+            return True
+        if o > 0x7F and (ch.isspace() or unicodedata.category(ch) == "Nd"):
+            return True
+    return False
 
 
 def _duration_micro(value: str) -> int | None:
@@ -282,6 +305,11 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
                         str_id[b, p, e] = interner.intern(value)
                     else:
                         host_flag[b] = True
+                    if _needs_host_parse(value):
+                        # unicode-sensitive parse: leave the numeric lanes
+                        # empty and let the oracle evaluate this resource
+                        host_flag[b] = True
+                        continue
                     n = _value_to_micro(value)
                     if n is not None:
                         num_val[b, p, e] = n

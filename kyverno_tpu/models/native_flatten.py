@@ -1,0 +1,220 @@
+"""ctypes loader for the native flattener (native/ktpu_flatten.cpp).
+
+The C++ library is the byte-parity twin of :mod:`.flatten` — same slot
+enumeration, interning order, and numeric decomposition — but parses the
+batch as one JSON blob instead of walking Python dicts, which removes the
+per-slot Python interpreter cost that dominated ``flatten_s`` in BENCH_r02.
+
+Build-on-demand: compiled with g++ into ``native/build/`` the first time
+it's needed (and rebuilt when the .cpp is newer). Every failure path —
+no compiler, compile error, dictionary overflow, unparseable input — falls
+back to the pure-Python flattener, so the native tier is a strict
+accelerator, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from .compiler import STR_LEN, PolicyTensors
+from .flatten import FlatBatch, flatten_batch
+from .ir import NSEFF_MARK, REQ_MARK
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_CPP = _REPO_ROOT / "native" / "ktpu_flatten.cpp"
+_SO = _REPO_ROOT / "native" / "build" / "libktpu_flatten.so"
+
+_lib = None
+_lib_failed = False
+
+
+def _load_lib():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        if not _SO.exists() or _SO.stat().st_mtime < _CPP.stat().st_mtime:
+            _SO.parent.mkdir(parents=True, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 str(_CPP), "-o", str(_SO)],
+                check=True, capture_output=True, timeout=120,
+            )
+        lib = ctypes.CDLL(str(_SO))
+    except (OSError, subprocess.SubprocessError):
+        _lib_failed = True
+        return None
+
+    lib.ktpu_create.restype = ctypes.c_void_p
+    lib.ktpu_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.ktpu_destroy.argtypes = [ctypes.c_void_p]
+    lib.ktpu_flatten_batch.restype = ctypes.c_int
+    lib.ktpu_flatten_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_int64,       # docs
+        ctypes.c_char_p, ctypes.c_int64,       # reqs (nullable)
+        ctypes.c_int, ctypes.c_int,            # n_docs, max_slots
+    ] + [ctypes.c_void_p] * 19 + [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return os.environ.get("KTPU_NATIVE", "1") != "0" and _load_lib() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+class NativeFlattener:
+    """Per-PolicyTensors native flatten context (path/kind dictionaries)."""
+
+    def __init__(self, tensors: PolicyTensors):
+        self.tensors = tensors
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native flattener unavailable")
+        kinds = [""] * len(tensors.kind_index)
+        for k, i in tensors.kind_index.items():
+            kinds[i] = k
+        if any("\n" in p for p in tensors.paths) or any("\n" in k for k in kinds):
+            # the '\n'-joined C ABI can't carry them; caller falls back
+            raise RuntimeError("newline in path/kind dictionary")
+        self._handle = lib.ktpu_create(
+            "\n".join(tensors.paths).encode("utf-8"),
+            "\n".join(kinds).encode("utf-8"),
+            STR_LEN, REQ_MARK.encode("utf-8"), NSEFF_MARK.encode("utf-8"),
+        )
+        self._lib = lib
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.ktpu_destroy(handle)
+            self._handle = None
+
+    def flatten(self, resources: list[dict], max_slots: int = 16,
+                requests: list[dict] | None = None) -> FlatBatch | None:
+        """FlatBatch identical to flatten_batch's, or None on any failure
+        (the caller then uses the Python flattener)."""
+        B, P, E = len(resources), self.tensors.n_paths, max_slots
+        try:
+            docs = json.dumps(resources).encode("utf-8")
+            reqs = (json.dumps(requests).encode("utf-8")
+                    if requests is not None else None)
+        except (TypeError, ValueError):
+            return None
+
+        mask = np.zeros((B, P, E), dtype=np.uint16)
+        slot_valid = np.zeros((B, P, E), dtype=bool)
+        null_break = np.zeros((B, P, E), dtype=bool)
+        type_tag = np.zeros((B, P, E), dtype=np.int8)
+        str_id = np.full((B, P, E), -1, dtype=np.int32)
+        num_val = np.zeros((B, P, E), dtype=np.int64)
+        num_ok = np.zeros((B, P, E), dtype=bool)
+        num_plain = np.zeros((B, P, E), dtype=bool)
+        num_int = np.zeros((B, P, E), dtype=bool)
+        dur_val = np.zeros((B, P, E), dtype=np.int64)
+        dur_ok = np.zeros((B, P, E), dtype=bool)
+        dur_any = np.zeros((B, P, E), dtype=bool)
+        bool_val = np.zeros((B, P, E), dtype=bool)
+        elem0 = np.full((B, P, E), -1, dtype=np.int32)
+        kind_id = np.full(B, -1, dtype=np.int32)
+        host_flag = np.zeros(B, dtype=bool)
+
+        str_cap = 1 << 16
+        while True:
+            str_bytes = np.zeros((str_cap, STR_LEN), dtype=np.uint8)
+            str_len = np.zeros(str_cap, dtype=np.int32)
+            str_glob = np.zeros(str_cap, dtype=bool)
+            n_strings = ctypes.c_int32(0)
+            e_used = self._lib.ktpu_flatten_batch(
+                self._handle, docs, len(docs), reqs,
+                len(reqs) if reqs is not None else 0,
+                B, E,
+                _ptr(mask), _ptr(slot_valid), _ptr(null_break),
+                _ptr(type_tag), _ptr(str_id),
+                _ptr(num_val), _ptr(num_ok), _ptr(num_plain), _ptr(num_int),
+                _ptr(dur_val), _ptr(dur_ok), _ptr(dur_any),
+                _ptr(bool_val), _ptr(elem0),
+                _ptr(kind_id), _ptr(host_flag),
+                _ptr(str_bytes), _ptr(str_len), _ptr(str_glob),
+                ctypes.byref(n_strings), str_cap,
+            )
+            if e_used == -1:
+                # n_strings reports the exact dictionary size needed
+                str_cap = max(str_cap * 2, n_strings.value)
+                if str_cap > (1 << 24):
+                    return None
+                continue
+            if e_used < 0:
+                return None
+            break
+
+        V = n_strings.value
+        strings = [
+            bytes(str_bytes[i, : str_len[i]]).decode("utf-8", "surrogateescape")
+            for i in range(V)
+        ]
+        Vp = max(1, V)
+
+        def cut(a):
+            return np.ascontiguousarray(a[:, :, :e_used])
+
+        nv = cut(num_val)
+        dv = cut(dur_val)
+        return FlatBatch(
+            n=B, e=e_used,
+            mask=cut(mask), slot_valid=cut(slot_valid),
+            null_break=cut(null_break), type_tag=cut(type_tag),
+            str_id=cut(str_id), num_val=nv,
+            num_hi=(nv >> 31).astype(np.int32),
+            num_lo=(nv & 0x7FFFFFFF).astype(np.int32),
+            num_ok=cut(num_ok), num_plain=cut(num_plain), num_int=cut(num_int),
+            dur_hi=(dv >> 31).astype(np.int32),
+            dur_lo=(dv & 0x7FFFFFFF).astype(np.int32),
+            dur_ok=cut(dur_ok), dur_any=cut(dur_any),
+            bool_val=cut(bool_val), elem0=cut(elem0),
+            kind_id=kind_id, host_flag=host_flag,
+            live=np.ones(B, dtype=bool),
+            # copies, not views: a view would pin the full str_cap buffer
+            # (~4.5 MB) for the FlatBatch's lifetime
+            str_bytes=str_bytes[:Vp].copy(), str_len=str_len[:Vp].copy(),
+            str_has_glob=str_glob[:Vp].copy(),
+            strings=strings,
+        )
+
+
+def flatten_batch_fast(resources: list[dict], tensors: PolicyTensors,
+                       max_slots: int = 16,
+                       requests: list[dict] | None = None,
+                       _cache: dict = {}) -> FlatBatch:
+    """Native flatten with transparent Python fallback; the drop-in
+    replacement for :func:`flatten_batch` used by CompiledPolicySet."""
+    if native_available():
+        ctx = _cache.get(id(tensors))
+        if ctx is None or ctx.tensors is not tensors:
+            try:
+                ctx = NativeFlattener(tensors)
+            except RuntimeError:
+                ctx = None
+            _cache.clear()          # one compiled set at a time is typical
+            _cache[id(tensors)] = ctx
+        if ctx is not None:
+            out = ctx.flatten(resources, max_slots=max_slots, requests=requests)
+            if out is not None:
+                return out
+    return flatten_batch(resources, tensors, max_slots=max_slots,
+                         requests=requests)

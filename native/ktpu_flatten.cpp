@@ -1,19 +1,23 @@
 // ktpu_flatten: resource JSON -> leaf slot tensors, the native twin of
-// kyverno_tpu/models/flatten.py (same layout, byte-for-byte).
+// kyverno_tpu/models/flatten.py (same layout, byte-for-byte — a parity test
+// in tests/ops/test_native_flatten.py diffs every array over the
+// cross-check corpus).
 //
 // The reference engine has no native code (SURVEY.md header); this library
 // is the new host-side component the north star calls for: admission
 // payloads arrive as JSON bytes, and turning them into device tensors is
 // the end-to-end bottleneck of the TPU path (bench.py flatten_s). It
-// parses JSON directly (no Python dict intermediary), enumerates the
-// compiled path dictionary against each document, interns the string
-// dictionary, and decomposes numbers/quantities into exact i64 micro-units
-// -- mirroring models/flatten.py semantics including phantom slots,
-// prefix-presence masks, host-lane flags, and Go-style float
-// stringification (utils/gofmt.py).
+// parses a JSON array of documents (one json.dumps for the whole batch on
+// the Python side), enumerates the compiled path dictionary against each
+// document, interns the string dictionary, and decomposes
+// numbers/quantities/durations into exact i64 micro-units — mirroring
+// models/flatten.py semantics including phantom slots, null-break chains,
+// prefix-presence masks, request-envelope and effective-namespace roots,
+// host-lane flags, and Go-style float stringification (utils/gofmt.py).
 //
 // C ABI only (consumed via ctypes; pybind11 is not in the image).
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <cstdio>
@@ -85,22 +89,23 @@ struct Parser {
         ++p;  // '{'
         skip_ws();
         if (p < end && *p == '}') { ++p; return v; }
-        while (p < end) {
+        while (ok) {
             skip_ws();
-            if (p >= end || *p != '"') { ok = false; return v; }
+            if (p >= end || *p != '"') { ok = false; break; }
             Value* key = parse_str();
+            if (!ok) break;
             skip_ws();
-            if (p >= end || *p != ':') { ok = false; return v; }
+            if (p >= end || *p != ':') { ok = false; break; }
             ++p;
             Value* val = parse();
-            if (!ok) return v;
+            if (!ok) break;
             v->obj.emplace_back(std::move(key->str), val);
             skip_ws();
             if (p < end && *p == ',') { ++p; continue; }
-            if (p < end && *p == '}') { ++p; return v; }
-            ok = false; return v;
+            if (p < end && *p == '}') { ++p; break; }
+            ok = false;
         }
-        ok = false; return v;
+        return v;
     }
 
     Value* parse_arr() {
@@ -108,21 +113,21 @@ struct Parser {
         ++p;  // '['
         skip_ws();
         if (p < end && *p == ']') { ++p; return v; }
-        while (p < end) {
+        while (ok) {
             Value* el = parse();
-            if (!ok) return v;
+            if (!ok) break;
             v->arr.push_back(el);
             skip_ws();
             if (p < end && *p == ',') { ++p; continue; }
-            if (p < end && *p == ']') { ++p; return v; }
-            ok = false; return v;
+            if (p < end && *p == ']') { ++p; break; }
+            ok = false;
         }
-        ok = false; return v;
+        return v;
     }
 
     Value* parse_str() {
         Value* v = alloc(); v->t = Value::Str;
-        ++p;  // '"'
+        ++p;  // opening '"'
         std::string& out = v->str;
         while (p < end && *p != '"') {
             if (*p == '\\') {
@@ -217,13 +222,17 @@ const Value* obj_get(const Value* v, std::string_view key) {
 
 // ------------------------------------------------------------ quantities
 
-// Exact micro-unit decomposition of a quantity token (utils/quantity.py +
-// models/ir.py quantity_to_micro). Returns false when not a quantity or
-// not exactly representable.
+// Exact micro-unit decomposition of a quantity token (utils/quantity.py
+// parse_quantity + models/flatten._value_to_micro). Returns false when not
+// a quantity or not exactly representable in micro-units <= NUM_MAX.
 bool quantity_to_micro(std::string_view s, int64_t* out) {
-    // trim
-    while (!s.empty() && (s.front() == ' ' || s.front() == '\t')) s.remove_prefix(1);
-    while (!s.empty() && (s.back() == ' ' || s.back() == '\t')) s.remove_suffix(1);
+    // str.strip() (ASCII whitespace set is what occurs in JSON strings)
+    auto is_ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+               c == '\f' || c == '\v';
+    };
+    while (!s.empty() && is_ws(s.front())) s.remove_prefix(1);
+    while (!s.empty() && is_ws(s.back())) s.remove_suffix(1);
     if (s.empty()) return false;
 
     size_t i = 0;
@@ -246,6 +255,7 @@ bool quantity_to_micro(std::string_view s, int64_t* out) {
             break;
         }
     }
+    // _QUANTITY_RE: \d+(\.\d*)? | \.\d+  — a bare "." or ".suffix" is invalid
     if (n_int == 0 && n_frac == 0) return false;
 
     std::string_view suffix = s.substr(i);
@@ -375,11 +385,181 @@ bool num_token_is_int(std::string_view raw) {
     return true;
 }
 
+// ------------------------------------------------------------ durations
+
+// utils/duration.py parse_duration twin: Go time.ParseDuration dialect.
+// Returns seconds; summation order and unit constants match the Python so
+// the doubles (and the banker's rounding to micro below) agree bit-exactly.
+bool parse_duration_secs(std::string_view s, double* out) {
+    auto is_ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+               c == '\f' || c == '\v';
+    };
+    while (!s.empty() && is_ws(s.front())) s.remove_prefix(1);
+    while (!s.empty() && is_ws(s.back())) s.remove_suffix(1);
+    bool neg = false;
+    if (!s.empty() && (s.front() == '+' || s.front() == '-')) {
+        neg = s.front() == '-';
+        s.remove_prefix(1);
+    }
+    if (s == "0") { *out = 0.0; return true; }
+    if (s.empty()) return false;
+    double total = 0.0;
+    size_t i = 0;
+    while (i < s.size()) {
+        // number: \d+(\.\d*)? | \.\d+
+        size_t start = i;
+        int nd = 0, nf = 0;
+        bool dot = false;
+        while (i < s.size()) {
+            char c = s[i];
+            if (c >= '0' && c <= '9') { ++i; if (dot) ++nf; else ++nd; }
+            else if (c == '.' && !dot) { dot = true; ++i; }
+            else break;
+        }
+        if (nd == 0 && nf == 0) return false;
+        double v = strtod(std::string(s.substr(start, i - start)).c_str(), nullptr);
+        // unit (longest match first): ns us µs μs ms s m h
+        double unit;
+        if (s.compare(i, 2, "ns") == 0) { unit = 1e-9; i += 2; }
+        else if (s.compare(i, 2, "us") == 0) { unit = 1e-6; i += 2; }
+        else if (s.compare(i, 3, "\xc2\xb5s") == 0) { unit = 1e-6; i += 3; }
+        else if (s.compare(i, 3, "\xce\xbcs") == 0) { unit = 1e-6; i += 3; }
+        else if (s.compare(i, 2, "ms") == 0) { unit = 1e-3; i += 2; }
+        else if (s.compare(i, 1, "s") == 0) { unit = 1.0; i += 1; }
+        else if (s.compare(i, 1, "m") == 0) { unit = 60.0; i += 1; }
+        else if (s.compare(i, 1, "h") == 0) { unit = 3600.0; i += 1; }
+        else return false;
+        total += v * unit;
+    }
+    *out = neg ? -total : total;
+    return true;
+}
+
+// models/flatten._duration_micro: round(secs * 1e6) — Python round() is
+// round-half-to-even, which nearbyint reproduces in the default FP mode.
+bool duration_micro(std::string_view s, int64_t* out) {
+    double secs;
+    if (!parse_duration_secs(s, &secs)) return false;
+    double m = std::nearbyint(secs * 1e6);
+    if (std::fabs(m) > double(NUM_MAX)) return false;
+    *out = int64_t(m);
+    return true;
+}
+
+// Python float() acceptance (num_plain flag for string leaves). Mirrors
+// CPython's float_from_string: optional ws, sign, inf/infinity/nan, or
+// decimal with single underscores *between* digits.
+bool py_float_ok(std::string_view s) {
+    auto is_ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+               c == '\f' || c == '\v';
+    };
+    while (!s.empty() && is_ws(s.front())) s.remove_prefix(1);
+    while (!s.empty() && is_ws(s.back())) s.remove_suffix(1);
+    if (s.empty()) return false;
+    size_t i = 0;
+    if (s[i] == '+' || s[i] == '-') ++i;
+    auto ci_is = [&](const char* word) {
+        size_t n = strlen(word);
+        if (s.size() - i != n) return false;
+        for (size_t k = 0; k < n; ++k)
+            if (tolower(s[i + k]) != word[k]) return false;
+        return true;
+    };
+    if (ci_is("inf") || ci_is("infinity") || ci_is("nan")) return true;
+    // digit run with single underscores between digits
+    auto digits = [&](bool* any) {
+        *any = false;
+        bool prev_digit = false;
+        while (i < s.size()) {
+            char c = s[i];
+            if (c >= '0' && c <= '9') { prev_digit = true; *any = true; ++i; }
+            else if (c == '_') {
+                if (!prev_digit || i + 1 >= s.size() ||
+                    s[i + 1] < '0' || s[i + 1] > '9') return false;
+                prev_digit = false;
+                ++i;
+            } else break;
+        }
+        return true;
+    };
+    bool int_any = false, frac_any = false;
+    if (!digits(&int_any)) return false;
+    if (i < s.size() && s[i] == '.') {
+        ++i;
+        if (!digits(&frac_any)) return false;
+    }
+    if (!int_any && !frac_any) return false;
+    if (i < s.size() && (s[i] == 'e' || s[i] == 'E')) {
+        ++i;
+        if (i < s.size() && (s[i] == '+' || s[i] == '-')) ++i;
+        bool exp_any = false;
+        if (!digits(&exp_any) || !exp_any) return false;
+    }
+    return i == s.size();
+}
+
+// The Python tier parses strings with unicode-aware rules (str.strip()
+// whitespace, regex \d, float()) while this library parses ASCII. The two
+// can only disagree when the string contains a unicode whitespace or a
+// non-ASCII decimal digit (ASCII success implies the string is pure ASCII)
+// — or the \x1c-\x1f controls Python's str.isspace() accepts. Such leaves
+// route the resource to the host lane, where the Python flattener is
+// authoritative.
+struct CpRange { uint32_t lo, hi; };
+constexpr CpRange UNI_WS_OR_DIGIT[] = {
+    {0x85,0x85},{0xA0,0xA0},{0x660,0x669},{0x6F0,0x6F9},{0x7C0,0x7C9},
+    {0x966,0x96F},{0x9E6,0x9EF},{0xA66,0xA6F},{0xAE6,0xAEF},{0xB66,0xB6F},
+    {0xBE6,0xBEF},{0xC66,0xC6F},{0xCE6,0xCEF},{0xD66,0xD6F},{0xDE6,0xDEF},
+    {0xE50,0xE59},{0xED0,0xED9},{0xF20,0xF29},{0x1040,0x1049},
+    {0x1090,0x1099},{0x1680,0x1680},{0x17E0,0x17E9},{0x1810,0x1819},
+    {0x1946,0x194F},{0x19D0,0x19D9},{0x1A80,0x1A89},{0x1A90,0x1A99},
+    {0x1B50,0x1B59},{0x1BB0,0x1BB9},{0x1C40,0x1C49},{0x1C50,0x1C59},
+    {0x2000,0x200A},{0x2028,0x2029},{0x202F,0x202F},{0x205F,0x205F},
+    {0x3000,0x3000},{0xA620,0xA629},{0xA8D0,0xA8D9},{0xA900,0xA909},
+    {0xA9D0,0xA9D9},{0xA9F0,0xA9F9},{0xAA50,0xAA59},{0xABF0,0xABF9},
+    {0xFF10,0xFF19},{0x104A0,0x104A9},{0x10D30,0x10D39},{0x11066,0x1106F},
+    {0x110F0,0x110F9},{0x11136,0x1113F},{0x111D0,0x111D9},
+    {0x112F0,0x112F9},{0x11450,0x11459},{0x114D0,0x114D9},
+    {0x11650,0x11659},{0x116C0,0x116C9},{0x11730,0x11739},
+    {0x118E0,0x118E9},{0x11950,0x11959},{0x11C50,0x11C59},
+    {0x11D50,0x11D59},{0x11DA0,0x11DA9},{0x11F50,0x11F59},
+    {0x16A60,0x16A69},{0x16AC0,0x16AC9},{0x16B50,0x16B59},
+    {0x1D7CE,0x1D7FF},{0x1E140,0x1E149},{0x1E2F0,0x1E2F9},
+    {0x1E4F0,0x1E4F9},{0x1E950,0x1E959},{0x1FBF0,0x1FBF9},
+};
+
+bool needs_python_parse(const std::string& s) {
+    for (size_t i = 0; i < s.size();) {
+        unsigned char c = s[i];
+        if (c < 0x80) {
+            if (c >= 0x1c && c <= 0x1f) return true;
+            ++i;
+            continue;
+        }
+        // decode one UTF-8 codepoint (already validated by the JSON layer)
+        uint32_t cp;
+        size_t n;
+        if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; n = 2; }
+        else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; n = 3; }
+        else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; n = 4; }
+        else { ++i; continue; }
+        if (i + n > s.size()) return true;  // malformed: be conservative
+        for (size_t k = 1; k < n; ++k) cp = (cp << 6) | (s[i + k] & 0x3F);
+        i += n;
+        for (const auto& r : UNI_WS_OR_DIGIT)
+            if (cp >= r.lo && cp <= r.hi) return true;
+    }
+    return false;
+}
+
 // ------------------------------------------------------------------ ctx
 
 struct Ctx {
     std::vector<std::vector<std::string>> paths;   // split segments
     std::unordered_map<std::string, int32_t> kinds;
+    std::string req_mark, nseff_mark;
     int str_len_cap = 64;
 };
 
@@ -397,54 +577,47 @@ struct Interner {
     }
 };
 
-struct Outputs {
-    uint16_t* mask;
-    uint8_t* slot_valid;
-    int8_t* type_tag;
-    int32_t* str_id;
-    int64_t* num_val;
-    uint8_t* num_ok;
-    uint8_t* bool_val;
-    int32_t* elem0;
-    int32_t* kind_id;
-    uint8_t* host_flag;
-    int P, E;
-};
-
 struct Slot {
     uint16_t mask;
     int32_t elem0;
-    const Value* leaf;   // nullptr => phantom
+    const Value* leaf;      // non-null only when leaf_present
+    bool leaf_present;      // distinguishes JSON null leaf from phantom
+    bool null_break;        // chain broke at an existing non-map node
 };
 
-void enumerate_slots(const Value* node, const std::vector<std::string>& segs,
-                     size_t i, uint16_t mask, int32_t elem0,
-                     std::vector<Slot>& out, int cap) {
-    if (int(out.size()) > cap) return;  // overflow checked by caller
+// _enumerate_slots walk(): identical traversal and bit layout.
+void walk_slots(const Value* node, const std::vector<std::string>& segs,
+                size_t i, size_t offset, uint16_t mask, int32_t elem0,
+                std::vector<Slot>& out, int cap) {
+    if (int(out.size()) > cap) return;
     if (i == segs.size()) {
-        out.push_back({mask, elem0, node});
+        out.push_back({mask, elem0, node, true, false});
         return;
     }
     const std::string& seg = segs[i];
+    uint16_t bit = uint16_t(1u << (i + 1 + offset));
     if (seg == "*") {
         if (node == nullptr || node->t != Value::Arr) {
-            out.push_back({mask, elem0, nullptr});
+            out.push_back({mask, elem0, nullptr, false, false});
             return;
         }
         int32_t idx = 0;
         for (const Value* el : node->arr) {
-            enumerate_slots(el, segs, i + 1, uint16_t(mask | (1u << (i + 1))),
-                            elem0 < 0 ? idx : elem0, out, cap);
+            walk_slots(el, segs, i + 1, offset, uint16_t(mask | bit),
+                       elem0 < 0 ? idx : elem0, out, cap);
             ++idx;
         }
     } else {
-        const Value* child = obj_get(node, seg);
-        if (child == nullptr) {
-            out.push_back({mask, elem0, nullptr});
+        if (node == nullptr || node->t != Value::Obj) {
+            out.push_back({mask, elem0, nullptr, false, true});
             return;
         }
-        enumerate_slots(child, segs, i + 1, uint16_t(mask | (1u << (i + 1))),
-                        elem0, out, cap);
+        const Value* child = obj_get(node, seg);
+        if (child == nullptr) {
+            out.push_back({mask, elem0, nullptr, false, false});
+            return;
+        }
+        walk_slots(child, segs, i + 1, offset, uint16_t(mask | bit), elem0, out, cap);
     }
 }
 
@@ -454,9 +627,13 @@ extern "C" {
 
 // paths: '\n'-joined SEP-separated generalized paths
 // kinds: '\n'-joined kind names (index == id, matching tensors.kind_index)
-void* ktpu_create(const char* paths, const char* kinds, int str_len_cap) {
+// req_mark / nseff_mark: the ir.REQ_MARK / ir.NSEFF_MARK sentinel segments
+void* ktpu_create(const char* paths, const char* kinds, int str_len_cap,
+                  const char* req_mark, const char* nseff_mark) {
     auto* ctx = new Ctx;
     ctx->str_len_cap = str_len_cap;
+    ctx->req_mark = req_mark ? req_mark : "";
+    ctx->nseff_mark = nseff_mark ? nseff_mark : "";
     std::string_view pv(paths ? paths : "");
     size_t start = 0;
     while (start <= pv.size() && !pv.empty()) {
@@ -496,44 +673,94 @@ void* ktpu_create(const char* paths, const char* kinds, int str_len_cap) {
 
 void ktpu_destroy(void* handle) { delete static_cast<Ctx*>(handle); }
 
-// Flatten a batch. Arrays are laid out [B, P, E] row-major with E =
-// max_slots; returns the maximum slot count actually used (<= max_slots),
-// or -1 when the string dictionary capacity was exceeded (caller retries
-// with a larger str_cap). Documents that fail to parse set host_flag.
+// Flatten a batch. ``docs`` is a JSON *array* of resource documents
+// (one json.dumps of the whole batch); ``reqs`` optionally a same-length
+// JSON array of admission envelopes (or NULL). [B,P,E] arrays are laid out
+// row-major with E = max_slots; the caller slices to the returned e_used.
+// Returns e_used (>=1), or -1 when the string dictionary exceeded str_cap
+// (caller retries with a larger cap), -2 on a top-level parse failure,
+// -3 when the parsed array length != n_docs.
 int ktpu_flatten_batch(
-    void* handle, const char* const* docs, const int32_t* doc_lens, int n_docs,
-    int max_slots,
-    uint16_t* mask, uint8_t* slot_valid, int8_t* type_tag, int32_t* str_id,
-    int64_t* num_val, uint8_t* num_ok, uint8_t* bool_val, int32_t* elem0,
+    void* handle,
+    const char* docs, int64_t docs_len,
+    const char* reqs, int64_t reqs_len,
+    int n_docs, int max_slots,
+    uint16_t* mask, uint8_t* slot_valid, uint8_t* null_break,
+    int8_t* type_tag, int32_t* str_id,
+    int64_t* num_val, uint8_t* num_ok, uint8_t* num_plain, uint8_t* num_int,
+    int64_t* dur_val, uint8_t* dur_ok, uint8_t* dur_any,
+    uint8_t* bool_val, int32_t* elem0,
     int32_t* kind_id, uint8_t* host_flag,
-    uint8_t* str_bytes, int32_t* str_lens, int32_t* n_strings, int str_cap) {
+    uint8_t* str_bytes, int32_t* str_lens, uint8_t* str_glob,
+    int32_t* n_strings, int str_cap) {
 
     Ctx* ctx = static_cast<Ctx*>(handle);
     const int P = int(ctx->paths.size());
     const int E = max_slots;
     const int L = ctx->str_len_cap;
+
+    std::deque<Value> arena;
+    Parser parser{docs, docs + docs_len, &arena};
+    Value* batch = parser.parse();
+    if (!parser.ok || batch == nullptr || batch->t != Value::Arr) return -2;
+    if (int(batch->arr.size()) != n_docs) return -3;
+
+    Value* req_batch = nullptr;
+    if (reqs != nullptr) {
+        Parser rp{reqs, reqs + reqs_len, &arena};
+        req_batch = rp.parse();
+        if (!rp.ok || req_batch == nullptr || req_batch->t != Value::Arr) return -2;
+        if (int(req_batch->arr.size()) != n_docs) return -3;
+    }
+
     Interner interner;
     int e_used = 1;
+    std::vector<Slot> slots;
+    Value nseff_leaf;          // synthetic Str node for NSEFF slots
+    nseff_leaf.t = Value::Str;
 
     for (int b = 0; b < n_docs; ++b) {
-        std::deque<Value> arena;
-        Parser parser{docs[b], docs[b] + doc_lens[b], &arena};
-        Value* root = parser.parse();
+        const Value* root = batch->arr[size_t(b)];
+        const Value* env = req_batch ? req_batch->arr[size_t(b)] : nullptr;
+        const bool env_nonempty =
+            env != nullptr && env->t == Value::Obj && !env->obj.empty();
+
+        // kind id + effective namespace (flatten.py _effective_namespace)
         kind_id[b] = -1;
-        if (!parser.ok || root == nullptr) {
-            host_flag[b] = 1;
-            continue;
-        }
-        const Value* kind_v = obj_get(root, "kind");
-        if (kind_v != nullptr && kind_v->t == Value::Str) {
-            auto it = ctx->kinds.find(kind_v->str);
+        std::string ns_eff;
+        if (root != nullptr && root->t == Value::Obj) {
+            const Value* kind_v = obj_get(root, "kind");
+            std::string kind = kind_v && kind_v->t == Value::Str ? kind_v->str : "";
+            auto it = ctx->kinds.find(kind);
             if (it != ctx->kinds.end()) kind_id[b] = it->second;
+            const Value* meta = obj_get(root, "metadata");
+            const Value* nv = obj_get(
+                meta, kind == "Namespace" ? "name" : "namespace");
+            if (nv != nullptr && nv->t == Value::Str) ns_eff = nv->str;
         }
 
-        std::vector<Slot> slots;
         for (int p = 0; p < P; ++p) {
             slots.clear();
-            enumerate_slots(root, ctx->paths[p], 0, 1, -1, slots, max_slots);
+            const auto& segs = ctx->paths[p];
+            if (!segs.empty() && segs[0] == ctx->nseff_mark) {
+                nseff_leaf.str = ns_eff;
+                slots.push_back({0b11, -1, &nseff_leaf, true, false});
+            } else if (!segs.empty() && segs[0] == ctx->req_mark) {
+                uint16_t base_mask = env_nonempty ? 0b11 : 0b1;
+                if (segs.size() == 1 || !env_nonempty) {
+                    slots.push_back({base_mask, -1, nullptr, false, false});
+                } else {
+                    // start at segment 1 with offset 0: bit = 1 << (i + 1)
+                    // equals the Python rest-walk's 1 << (j + 1 + offset)
+                    walk_slots(env, segs, 1, 0, base_mask, -1, slots, max_slots);
+                }
+            } else if (root == nullptr || root->t == Value::Null) {
+                // flatten.py: `if root is None` -> single phantom slot
+                slots.push_back({0b1, -1, nullptr, false, false});
+            } else {
+                walk_slots(root, segs, 0, 0, 0b1, -1, slots, max_slots);
+            }
+
             if (int(slots.size()) > max_slots) {
                 host_flag[b] = 1;
                 slots.resize(size_t(max_slots));
@@ -545,9 +772,10 @@ int ktpu_flatten_batch(
                 const Slot& slot = slots[size_t(e)];
                 mask[o] = slot.mask;
                 slot_valid[o] = 1;
+                null_break[o] = slot.null_break ? 1 : 0;
                 elem0[o] = slot.elem0;
+                if (!slot.leaf_present) continue;  // phantom: T_ABSENT default
                 const Value* v = slot.leaf;
-                if (v == nullptr) continue;  // phantom: T_ABSENT default
                 switch (v->t) {
                     case Value::Null:
                         type_tag[o] = T_NULL;
@@ -560,8 +788,10 @@ int ktpu_flatten_batch(
                     }
                     case Value::Num: {
                         type_tag[o] = T_NUM;
+                        const bool is_int = num_token_is_int(v->raw);
+                        num_int[o] = is_int ? 1 : 0;
                         std::string text;
-                        if (num_token_is_int(v->raw)) {
+                        if (is_int) {
                             text = std::string(v->raw);
                             if (!text.empty() && text[0] == '+') text.erase(0, 1);
                         } else {
@@ -573,6 +803,7 @@ int ktpu_flatten_batch(
                         if (quantity_to_micro(v->raw, &micro)) {
                             num_val[o] = micro;
                             num_ok[o] = 1;
+                            num_plain[o] = 1;
                         } else {
                             host_flag[b] = 1;
                         }
@@ -582,10 +813,23 @@ int ktpu_flatten_batch(
                         type_tag[o] = T_STR;
                         if (int(v->str.size()) <= L) str_id[o] = interner.intern(v->str);
                         else host_flag[b] = 1;
+                        if (needs_python_parse(v->str)) {
+                            // unicode-sensitive parse: empty numeric lanes,
+                            // oracle evaluates this resource (host lane)
+                            host_flag[b] = 1;
+                            break;
+                        }
                         int64_t micro;
                         if (quantity_to_micro(v->str, &micro)) {
                             num_val[o] = micro;
                             num_ok[o] = 1;
+                            if (py_float_ok(v->str)) num_plain[o] = 1;
+                        }
+                        int64_t dmicro;
+                        if (duration_micro(v->str, &dmicro)) {
+                            dur_val[o] = dmicro;
+                            dur_any[o] = 1;
+                            dur_ok[o] = v->str != "0" ? 1 : 0;
                         }
                         break;
                     }
@@ -601,15 +845,17 @@ int ktpu_flatten_batch(
     }
 
     const int V = int(interner.strings.size());
+    *n_strings = V;  // on overflow: tells the caller the exact size to retry
     if (V > str_cap) return -1;
-    const int L = ctx->str_len_cap;
     for (int v = 0; v < V; ++v) {
         const std::string& s = interner.strings[size_t(v)];
         int len = int(s.size()) < L ? int(s.size()) : L;
-        memcpy(str_bytes + size_t(v) * L, s.data(), size_t(len));
+        memcpy(str_bytes + size_t(v) * size_t(L), s.data(), size_t(len));
         str_lens[v] = len;
+        str_glob[v] =
+            s.find('*') != std::string::npos || s.find('?') != std::string::npos
+                ? 1 : 0;
     }
-    *n_strings = V < 1 ? 1 : V;
     return e_used;
 }
 

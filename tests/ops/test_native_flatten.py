@@ -1,0 +1,129 @@
+"""Byte-parity: native flattener vs the pure-Python reference.
+
+Every array of the FlatBatch produced by native/ktpu_flatten.cpp must equal
+flatten_batch's output exactly — including interning order, phantom slots,
+null-break chains, numeric/duration decomposition and host-lane flags —
+over the full adversarial cross-check corpus.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policies_from_path, load_policy
+from kyverno_tpu.models import CompiledPolicySet
+from kyverno_tpu.models.flatten import BATCH_ARRAYS, DICT_ARRAYS, flatten_batch
+from kyverno_tpu.models.native_flatten import NativeFlattener, native_available
+
+from test_cross_check import ADVERSARIAL_POLICIES, SYNTHETIC_POLICIES, corpus  # noqa: F401
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native flattener not built"
+)
+
+
+@pytest.fixture(scope="module")
+def tensors():
+    policies = load_policies_from_path("/root/reference/test/best_practices/")
+    policies += [load_policy(doc) for doc in SYNTHETIC_POLICIES]
+    policies += [load_policy(doc) for doc in ADVERSARIAL_POLICIES]
+    return CompiledPolicySet(policies).tensors
+
+
+def assert_batches_equal(got, want):
+    assert got.n == want.n and got.e == want.e
+    for name in BATCH_ARRAYS + DICT_ARRAYS + ("num_val", "elem0"):
+        g, w = getattr(got, name), getattr(want, name)
+        assert g.dtype == w.dtype, name
+        assert g.shape == w.shape, (name, g.shape, w.shape)
+        if not np.array_equal(g, w):
+            bad = np.argwhere(np.asarray(g) != np.asarray(w))[:5]
+            raise AssertionError(f"{name} differs at {bad.tolist()}")
+    assert got.strings == want.strings
+
+
+def test_native_parity_corpus(tensors, corpus):  # noqa: F811
+    native = NativeFlattener(tensors)
+    got = native.flatten(corpus)
+    assert got is not None
+    want = flatten_batch(corpus, tensors)
+    assert_batches_equal(got, want)
+
+
+def test_native_parity_edge_values(tensors):
+    resources = [
+        # deep numeric / quantity / duration strings
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "edge", "namespace": "prod",
+                      "annotations": {"timeout": "1h30m", "mem": "0.1",
+                                      "team": "α-unicode- "}},
+         "spec": {"containers": [
+             {"name": "c", "image": "nginx:latest",
+              "resources": {"requests": {"memory": "64Mi", "cpu": 0.5},
+                            "limits": {"memory": "1e3", "cpu": 2}}},
+             {"name": "d", "image": "x" * 80},  # > STR_LEN -> host lane
+         ]}},
+        # null leaves, scalar-through, empty containers
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": None, "labels": {"tier": "web"}},
+         "spec": {"containers": [], "hostNetwork": "not-a-bool"}},
+        # non-dict spec: null-break chains
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "nb"},
+         "spec": "oops"},
+        # Namespace kind: effective-namespace synthetic path
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": "ns1"}},
+        # floats that exercise Go scientific formatting + big ints
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "nums", "annotations": {"mem": "2Gi"}},
+         "spec": {"containers": [{"name": "n", "ports": [
+             {"containerPort": 10.25}, {"containerPort": 2 ** 70},
+             {"containerPort": -3}, {"containerPort": 1e-7},
+         ]}]}},
+        # binary-repr artifact float: host lane on both tiers
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "f"},
+         "spec": {"replicas": 0.1 + 0.2}},
+        # unicode whitespace / digits: parse differs under unicode rules ->
+        # host lane with empty numeric lanes on both tiers
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "u", "annotations": {
+             "timeout": " 30s", "mem": "６４4Mi",
+             "ctl": "\x1c5s"}},
+         "spec": {}},
+    ]
+    native = NativeFlattener(tensors)
+    got = native.flatten(resources)
+    assert got is not None
+    want = flatten_batch(resources, tensors)
+    assert_batches_equal(got, want)
+
+
+def test_native_parity_requests_envelope(tensors):
+    resources = [
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "a"},
+         "spec": {"hostPID": True}},
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "b"},
+         "spec": {}},
+    ]
+    requests = [
+        {"operation": "CREATE", "namespace": "prod",
+         "userInfo": {"username": "alice", "groups": ["dev"]}},
+        None,
+    ]
+    native = NativeFlattener(tensors)
+    got = native.flatten(resources, requests=requests)
+    assert got is not None
+    want = flatten_batch(resources, tensors, requests=requests)
+    assert_batches_equal(got, want)
+
+
+def test_fields_covered():
+    """BATCH_ARRAYS/DICT_ARRAYS + the host-side i64 sources cover every
+    FlatBatch field, so the parity loop can't silently skip a new one."""
+    from kyverno_tpu.models.flatten import FlatBatch
+
+    field_names = {f.name for f in dataclasses.fields(FlatBatch)}
+    checked = set(BATCH_ARRAYS + DICT_ARRAYS) | {
+        "num_val", "elem0", "strings", "n", "e", "dur_val"}
+    missing = field_names - checked
+    assert not missing, f"parity test misses FlatBatch fields: {missing}"
