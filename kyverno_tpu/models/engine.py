@@ -18,7 +18,7 @@ from ..engine.policy_context import PolicyContext
 from ..engine.response import RuleStatus
 from ..engine.validation import validate as oracle_validate
 from .compiler import PolicyTensors, compile_tensors
-from .flatten import FlatBatch, flatten_batch
+from .flatten import FlatBatch
 from .ir import compile_rule_ir
 
 

@@ -132,6 +132,29 @@ def _value_to_micro(value) -> int | None:
     return int(micro)
 
 
+def _digit_capped(s: str) -> bool:
+    """True when the leading number part has more than 36 digits — beyond
+    the native flattener's exact __int128 range. Mirrors the counting loop
+    in ktpu_flatten.cpp quantity_to_micro: ASCII-trim, optional sign, then
+    digits with a single embedded dot."""
+    s = s.strip(" \t\n\r\f\v")
+    i = 0
+    if i < len(s) and s[i] in "+-":
+        i += 1
+    n = 0
+    seen_dot = False
+    for ch in s[i:]:
+        if "0" <= ch <= "9":
+            n += 1
+            if n > 36:
+                return True
+        elif ch == "." and not seen_dot:
+            seen_dot = True
+        else:
+            break
+    return False
+
+
 def _needs_host_parse(s: str) -> bool:
     """True when the string could parse differently under unicode-aware
     rules (str.strip(), regex \\d, float()) than under the ASCII grammar
@@ -308,6 +331,10 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
                     if _needs_host_parse(value):
                         # unicode-sensitive parse: leave the numeric lanes
                         # empty and let the oracle evaluate this resource
+                        host_flag[b] = True
+                        continue
+                    if _digit_capped(value):
+                        # >36-digit number part: exact range exceeded
                         host_flag[b] = True
                         continue
                     n = _value_to_micro(value)

@@ -63,6 +63,7 @@ def _load_lib():
         ctypes.c_char_p, ctypes.c_int64,       # docs
         ctypes.c_char_p, ctypes.c_int64,       # reqs (nullable)
         ctypes.c_int, ctypes.c_int,            # n_docs, max_slots
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),  # e_cap, e_needed
     ] + [ctypes.c_void_p] * 19 + [
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
     ]
@@ -109,7 +110,7 @@ class NativeFlattener:
                 requests: list[dict] | None = None) -> FlatBatch | None:
         """FlatBatch identical to flatten_batch's, or None on any failure
         (the caller then uses the Python flattener)."""
-        B, P, E = len(resources), self.tensors.n_paths, max_slots
+        B, P = len(resources), self.tensors.n_paths
         try:
             docs = json.dumps(resources).encode("utf-8")
             reqs = (json.dumps(requests).encode("utf-8")
@@ -117,33 +118,37 @@ class NativeFlattener:
         except (TypeError, ValueError):
             return None
 
-        mask = np.zeros((B, P, E), dtype=np.uint16)
-        slot_valid = np.zeros((B, P, E), dtype=bool)
-        null_break = np.zeros((B, P, E), dtype=bool)
-        type_tag = np.zeros((B, P, E), dtype=np.int8)
-        str_id = np.full((B, P, E), -1, dtype=np.int32)
-        num_val = np.zeros((B, P, E), dtype=np.int64)
-        num_ok = np.zeros((B, P, E), dtype=bool)
-        num_plain = np.zeros((B, P, E), dtype=bool)
-        num_int = np.zeros((B, P, E), dtype=bool)
-        dur_val = np.zeros((B, P, E), dtype=np.int64)
-        dur_ok = np.zeros((B, P, E), dtype=bool)
-        dur_any = np.zeros((B, P, E), dtype=bool)
-        bool_val = np.zeros((B, P, E), dtype=bool)
-        elem0 = np.full((B, P, E), -1, dtype=np.int32)
-        kind_id = np.full(B, -1, dtype=np.int32)
-        host_flag = np.zeros(B, dtype=bool)
-
-        str_cap = 1 << 16
+        # most batches need 1-4 slots per path; retry with the full stride
+        # when a document exceeds the initial guess (-4)
+        e_cap = min(4, max_slots)
+        str_cap = 1 << 14
         while True:
+            E = e_cap
+            mask = np.zeros((B, P, E), dtype=np.uint16)
+            slot_valid = np.zeros((B, P, E), dtype=bool)
+            null_break = np.zeros((B, P, E), dtype=bool)
+            type_tag = np.zeros((B, P, E), dtype=np.int8)
+            str_id = np.full((B, P, E), -1, dtype=np.int32)
+            num_val = np.zeros((B, P, E), dtype=np.int64)
+            num_ok = np.zeros((B, P, E), dtype=bool)
+            num_plain = np.zeros((B, P, E), dtype=bool)
+            num_int = np.zeros((B, P, E), dtype=bool)
+            dur_val = np.zeros((B, P, E), dtype=np.int64)
+            dur_ok = np.zeros((B, P, E), dtype=bool)
+            dur_any = np.zeros((B, P, E), dtype=bool)
+            bool_val = np.zeros((B, P, E), dtype=bool)
+            elem0 = np.full((B, P, E), -1, dtype=np.int32)
+            kind_id = np.full(B, -1, dtype=np.int32)
+            host_flag = np.zeros(B, dtype=bool)
             str_bytes = np.zeros((str_cap, STR_LEN), dtype=np.uint8)
             str_len = np.zeros(str_cap, dtype=np.int32)
             str_glob = np.zeros(str_cap, dtype=bool)
             n_strings = ctypes.c_int32(0)
+            e_needed = ctypes.c_int32(0)
             e_used = self._lib.ktpu_flatten_batch(
                 self._handle, docs, len(docs), reqs,
                 len(reqs) if reqs is not None else 0,
-                B, E,
+                B, max_slots, e_cap, ctypes.byref(e_needed),
                 _ptr(mask), _ptr(slot_valid), _ptr(null_break),
                 _ptr(type_tag), _ptr(str_id),
                 _ptr(num_val), _ptr(num_ok), _ptr(num_plain), _ptr(num_int),
@@ -158,6 +163,9 @@ class NativeFlattener:
                 str_cap = max(str_cap * 2, n_strings.value)
                 if str_cap > (1 << 24):
                     return None
+                continue
+            if e_used == -4:
+                e_cap = max_slots
                 continue
             if e_used < 0:
                 return None

@@ -49,13 +49,38 @@ struct Value {
     std::vector<Value*> arr;
 };
 
+// Value pool: reset() reuses nodes (and their vector/string capacity)
+// across documents, so steady-state parsing does no heap allocation.
+struct Arena {
+    std::deque<Value> store;
+    size_t used = 0;
+
+    Value* alloc() {
+        if (used < store.size()) {
+            Value* v = &store[used++];
+            v->t = Value::Null;
+            v->b = false;
+            v->raw = {};
+            v->str.clear();
+            v->obj.clear();
+            v->arr.clear();
+            return v;
+        }
+        store.emplace_back();
+        ++used;
+        return &store.back();
+    }
+
+    void reset() { used = 0; }
+};
+
 struct Parser {
     const char* p;
     const char* end;
-    std::deque<Value>* arena;
+    Arena* arena;
     bool ok = true;
 
-    Value* alloc() { arena->emplace_back(); return &arena->back(); }
+    Value* alloc() { return arena->alloc(); }
 
     void skip_ws() {
         while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
@@ -225,7 +250,8 @@ const Value* obj_get(const Value* v, std::string_view key) {
 // Exact micro-unit decomposition of a quantity token (utils/quantity.py
 // parse_quantity + models/flatten._value_to_micro). Returns false when not
 // a quantity or not exactly representable in micro-units <= NUM_MAX.
-bool quantity_to_micro(std::string_view s, int64_t* out) {
+bool quantity_to_micro(std::string_view s, int64_t* out,
+                       bool* capped = nullptr) {
     // str.strip() (ASCII whitespace set is what occurs in JSON strings)
     auto is_ws = [](char c) {
         return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
@@ -246,7 +272,12 @@ bool quantity_to_micro(std::string_view s, int64_t* out) {
     for (; i < s.size(); ++i) {
         char c = s[i];
         if (c >= '0' && c <= '9') {
-            if (++total_digits > 36) return false;  // beyond exact range
+            if (++total_digits > 36) {
+                // beyond the exact __int128 range; the Python tier has no
+                // digit cap, so such leaves route to the host lane
+                if (capped) *capped = true;
+                return false;
+            }
             digits = digits * 10 + (c - '0');
             if (seen_dot) ++n_frac; else ++n_int;
         } else if (c == '.' && !seen_dot) {
@@ -418,7 +449,11 @@ bool parse_duration_secs(std::string_view s, double* out) {
             else break;
         }
         if (nd == 0 && nf == 0) return false;
-        double v = strtod(std::string(s.substr(start, i - start)).c_str(), nullptr);
+        double v = 0.0;
+        {
+            std::string tok(s.substr(start, i - start));
+            std::from_chars(tok.data(), tok.data() + tok.size(), v);
+        }
         // unit (longest match first): ns us µs μs ms s m h
         double unit;
         if (s.compare(i, 2, "ns") == 0) { unit = 1e-9; i += 2; }
@@ -673,18 +708,55 @@ void* ktpu_create(const char* paths, const char* kinds, int str_len_cap,
 
 void ktpu_destroy(void* handle) { delete static_cast<Ctx*>(handle); }
 
+// Streams the next element out of a top-level JSON array: consumes '[' on
+// the first call, then one value and its ',' / ']' delimiter per call.
+// Keeps memory flat: one document's tree lives in the arena at a time.
+struct ArrayStream {
+    Parser parser;
+    bool started = false;
+    bool done = false;
+
+    Value* next() {
+        parser.skip_ws();
+        if (!started) {
+            if (parser.p >= parser.end || *parser.p != '[') {
+                parser.ok = false;
+                return nullptr;
+            }
+            ++parser.p;
+            started = true;
+            parser.skip_ws();
+            if (parser.p < parser.end && *parser.p == ']') {
+                ++parser.p;
+                done = true;
+                return nullptr;
+            }
+        }
+        if (done) return nullptr;
+        Value* v = parser.parse();
+        if (!parser.ok) return nullptr;
+        parser.skip_ws();
+        if (parser.p < parser.end && *parser.p == ',') ++parser.p;
+        else if (parser.p < parser.end && *parser.p == ']') done = true;
+        else parser.ok = false;
+        return parser.ok ? v : nullptr;
+    }
+};
+
 // Flatten a batch. ``docs`` is a JSON *array* of resource documents
 // (one json.dumps of the whole batch); ``reqs`` optionally a same-length
-// JSON array of admission envelopes (or NULL). [B,P,E] arrays are laid out
-// row-major with E = max_slots; the caller slices to the returned e_used.
-// Returns e_used (>=1), or -1 when the string dictionary exceeded str_cap
-// (caller retries with a larger cap), -2 on a top-level parse failure,
-// -3 when the parsed array length != n_docs.
+// JSON array of admission envelopes (or NULL). [B,P,e_cap] arrays are laid
+// out row-major; slot lists are truncated to max_slots (host_flag beyond
+// that, as in flatten.py). Returns e_used (>=1, <= e_cap), or
+//  -1  string dictionary exceeded str_cap (*n_strings = size needed)
+//  -2  top-level parse failure
+//  -3  array length != n_docs
+//  -4  a slot list exceeded e_cap (*e_needed = stride to retry with)
 int ktpu_flatten_batch(
     void* handle,
     const char* docs, int64_t docs_len,
     const char* reqs, int64_t reqs_len,
-    int n_docs, int max_slots,
+    int n_docs, int max_slots, int e_cap, int32_t* e_needed,
     uint16_t* mask, uint8_t* slot_valid, uint8_t* null_break,
     int8_t* type_tag, int32_t* str_id,
     int64_t* num_val, uint8_t* num_ok, uint8_t* num_plain, uint8_t* num_int,
@@ -696,22 +768,12 @@ int ktpu_flatten_batch(
 
     Ctx* ctx = static_cast<Ctx*>(handle);
     const int P = int(ctx->paths.size());
-    const int E = max_slots;
+    const int E = e_cap;
     const int L = ctx->str_len_cap;
 
-    std::deque<Value> arena;
-    Parser parser{docs, docs + docs_len, &arena};
-    Value* batch = parser.parse();
-    if (!parser.ok || batch == nullptr || batch->t != Value::Arr) return -2;
-    if (int(batch->arr.size()) != n_docs) return -3;
-
-    Value* req_batch = nullptr;
-    if (reqs != nullptr) {
-        Parser rp{reqs, reqs + reqs_len, &arena};
-        req_batch = rp.parse();
-        if (!rp.ok || req_batch == nullptr || req_batch->t != Value::Arr) return -2;
-        if (int(req_batch->arr.size()) != n_docs) return -3;
-    }
+    Arena arena;
+    ArrayStream doc_stream{Parser{docs, docs + docs_len, &arena}};
+    ArrayStream req_stream{Parser{reqs, reqs + (reqs ? reqs_len : 0), &arena}};
 
     Interner interner;
     int e_used = 1;
@@ -720,8 +782,16 @@ int ktpu_flatten_batch(
     nseff_leaf.t = Value::Str;
 
     for (int b = 0; b < n_docs; ++b) {
-        const Value* root = batch->arr[size_t(b)];
-        const Value* env = req_batch ? req_batch->arr[size_t(b)] : nullptr;
+        arena.reset();         // previous document's tree: memory stays flat
+        const Value* root = doc_stream.next();
+        if (!doc_stream.parser.ok) return -2;
+        if (root == nullptr) return -3;  // array shorter than n_docs
+        const Value* env = nullptr;
+        if (reqs != nullptr) {
+            env = req_stream.next();
+            if (!req_stream.parser.ok) return -2;
+            if (env == nullptr) return -3;
+        }
         const bool env_nonempty =
             env != nullptr && env->t == Value::Obj && !env->obj.empty();
 
@@ -765,6 +835,10 @@ int ktpu_flatten_batch(
                 host_flag[b] = 1;
                 slots.resize(size_t(max_slots));
             }
+            if (int(slots.size()) > E) {
+                *e_needed = int(slots.size());
+                return -4;     // caller re-allocates with a larger stride
+            }
             if (int(slots.size()) > e_used) e_used = int(slots.size());
 
             for (int e = 0; e < int(slots.size()); ++e) {
@@ -795,8 +869,11 @@ int ktpu_flatten_batch(
                             text = std::string(v->raw);
                             if (!text.empty() && text[0] == '+') text.erase(0, 1);
                         } else {
-                            text = format_float_sci(strtod(
-                                std::string(v->raw).c_str(), nullptr));
+                            double fv = 0.0;
+                            std::string tok(v->raw);
+                            std::from_chars(tok.data(),
+                                            tok.data() + tok.size(), fv);
+                            text = format_float_sci(fv);
                         }
                         if (int(text.size()) <= L) str_id[o] = interner.intern(text);
                         int64_t micro;
@@ -820,10 +897,16 @@ int ktpu_flatten_batch(
                             break;
                         }
                         int64_t micro;
-                        if (quantity_to_micro(v->str, &micro)) {
+                        bool capped = false;
+                        if (quantity_to_micro(v->str, &micro, &capped)) {
                             num_val[o] = micro;
                             num_ok[o] = 1;
                             if (py_float_ok(v->str)) num_plain[o] = 1;
+                        }
+                        else if (capped) {
+                            // >36-digit number part: exact range exceeded
+                            host_flag[b] = 1;
+                            break;
                         }
                         int64_t dmicro;
                         if (duration_micro(v->str, &dmicro)) {
@@ -842,6 +925,12 @@ int ktpu_flatten_batch(
                 }
             }
         }
+    }
+
+    if (!doc_stream.done) {
+        // n_docs == 0 with "[]" still pending, or extra elements: check
+        if (doc_stream.next() != nullptr || !doc_stream.done) return -3;
+        if (!doc_stream.parser.ok) return -2;
     }
 
     const int V = int(interner.strings.size());

@@ -83,6 +83,12 @@ def test_native_parity_edge_values(tensors):
         # binary-repr artifact float: host lane on both tiers
         {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "f"},
          "spec": {"replicas": 0.1 + 0.2}},
+        # >36-digit number part (exact even after the exponent): host lane
+        # with empty numeric lanes on both tiers
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "cap",
+         "annotations": {"mem": "0.0000000000000000000000000000000000001e31",
+                         "big": "9" * 40}},
+         "spec": {}},
         # unicode whitespace / digits: parse differs under unicode rules ->
         # host lane with empty numeric lanes on both tiers
         {"apiVersion": "v1", "kind": "Pod",
