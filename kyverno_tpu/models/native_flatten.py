@@ -165,7 +165,9 @@ class NativeFlattener:
                     return None
                 continue
             if e_used == -4:
-                e_cap = max_slots
+                # e_needed is already <= max_slots (slot lists are
+                # truncated before the stride check)
+                e_cap = max(e_cap + 1, e_needed.value)
                 continue
             if e_used < 0:
                 return None

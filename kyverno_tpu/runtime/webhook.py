@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..engine.context import Context, mutate_resource_with_image_info
 from ..engine.generation import generate as engine_generate
+from ..engine.image_verify import verify_and_patch_images
 from ..engine.mutation import mutate as engine_mutate
 from ..engine.policy_context import PolicyContext
 from ..engine.response import RuleStatus
@@ -77,12 +78,15 @@ class WebhookServer:
                  config: ConfigData | None = None, client=None,
                  event_gen: EventGenerator | None = None,
                  report_gen: ReportGenerator | None = None,
-                 registry=None):
+                 registry=None, image_verifier=None):
+        from ..engine.image_verify import Verifier
+
         self.policy_cache = policy_cache or PolicyCache()
         self.config = config or ConfigData()
         self.client = client
         self.event_gen = event_gen
         self.report_gen = report_gen
+        self.image_verifier = image_verifier or Verifier()
         self.registry = registry or metrics_mod.registry()
         self.audit_handler = AuditHandler(self._process_audit)
         self.last_request_time = time.time()
@@ -206,6 +210,39 @@ class WebhookServer:
                     self.registry, policy.name, rule.name, rule.status.value,
                     resource_kind=kind,
                     request_operation=request.get("operation", "CREATE"))
+
+        # image verification after mutate policies (server.go:325
+        # applyImageVerifyPolicies): every policy is applied and recorded,
+        # THEN an enforce-mode failure blocks (verify_images.go:36-48
+        # handleVerifyImages + common.go:30 toBlockResource)
+        verify_policies = self.policy_cache.get_policies(
+            PolicyType.VERIFY_IMAGES, kind, namespace)
+        blocked_msgs: list[str] = []
+        if verify_policies:
+            vctx = self._policy_context(request, resource)
+            for policy in verify_policies:
+                vctx.policy = policy
+                resp = verify_and_patch_images(vctx, self.image_verifier)
+                engine_responses.append(resp)
+                patches.extend(resp.patches)
+                for rule in resp.policy_response.rules:
+                    metrics_mod.record_policy_results(
+                        self.registry, policy.name, rule.name,
+                        rule.status.value, resource_kind=kind,
+                        request_operation=request.get("operation", "CREATE"))
+                if (not resp.successful
+                        and policy.spec.validation_failure_action == "enforce"):
+                    blocked_msgs += [r.message
+                                     for r in resp.policy_response.rules
+                                     if not r.success]
+        if blocked_msgs:
+            if self.event_gen is not None:
+                for r in engine_responses:
+                    self.event_gen.add(*events_for_engine_response(
+                        r, self.config.generate_success_events()))
+            return _admission_response(
+                uid, False,
+                message=f"image verification failed: {'; '.join(blocked_msgs)}")
 
         if self.event_gen is not None:
             for resp in engine_responses:

@@ -1,0 +1,216 @@
+"""Image verification engine vs pkg/engine/imageVerify.go semantics."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.image_verify import (
+    StaticVerifier,
+    json_pointer_to_jmespath,
+    verify_and_patch_images,
+)
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.config import ConfigData
+from kyverno_tpu.runtime.events import EventGenerator
+from kyverno_tpu.runtime.metrics import MetricsRegistry
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.reports import ReportGenerator
+from kyverno_tpu.runtime.webhook import MUTATING_WEBHOOK_PATH, WebhookServer
+
+DIGEST = "sha256:" + "ab" * 32
+
+
+def verify_policy(image="ghcr.io/acme/*", key="k1", attestations=None,
+                  action="enforce"):
+    iv = {"image": image, "key": key}
+    if attestations:
+        iv["attestations"] = attestations
+    return load_policy({
+        "apiVersion": "kyverno.io/v1",
+        "kind": "ClusterPolicy",
+        "metadata": {"name": "check-images"},
+        "spec": {
+            "validationFailureAction": action,
+            "rules": [{
+                "name": "verify-signature",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "verifyImages": [iv],
+            }],
+        },
+    })
+
+
+def pod(image="ghcr.io/acme/app:v1", name="p"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "image": image}]},
+    }
+
+
+def run(policy, resource, verifier):
+    ctx = Context()
+    ctx.add_resource(resource)
+    ctx.add_image_info(resource)
+    return verify_and_patch_images(
+        PolicyContext(policy=policy, new_resource=resource, json_context=ctx),
+        verifier,
+    )
+
+
+def test_json_pointer_to_jmespath():
+    assert (json_pointer_to_jmespath("/spec/containers/0/image")
+            == "spec.containers[0].image")
+    assert (json_pointer_to_jmespath("/spec/initContainers/12/image")
+            == "spec.initContainers[12].image")
+
+
+class TestSignatureVerification:
+    def test_signed_image_passes_and_gets_digest_patch(self):
+        v = StaticVerifier()
+        v.sign("ghcr.io/acme/app:v1", DIGEST, key="k1")
+        resp = run(verify_policy(), pod(), v)
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.PASS
+        assert rule.patches == [{
+            "op": "replace",
+            "path": "/spec/containers/0/image",
+            "value": f"ghcr.io/acme/app:v1@{DIGEST}",
+        }]
+
+    def test_unsigned_image_fails(self):
+        resp = run(verify_policy(), pod(), StaticVerifier())
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.FAIL
+        assert "signature verification failed" in rule.message
+
+    def test_wrong_key_fails(self):
+        v = StaticVerifier()
+        v.sign("ghcr.io/acme/app:v1", DIGEST, key="other-key")
+        resp = run(verify_policy(key="k1"), pod(), v)
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.FAIL
+
+    def test_image_with_digest_not_repatched(self):
+        image = f"ghcr.io/acme/app:v1@{DIGEST}"
+        v = StaticVerifier()
+        v.sign(image, DIGEST, key="k1")
+        resp = run(verify_policy(), pod(image=image), v)
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.PASS
+        assert rule.patches == []  # imageVerify.go:203 digest already set
+
+    def test_non_matching_image_pattern_skipped(self):
+        resp = run(verify_policy(image="docker.io/other/*"), pod(),
+                   StaticVerifier())
+        assert resp.policy_response.rules == []
+        assert resp.successful
+
+    def test_non_matching_kind_skipped(self):
+        svc = {"apiVersion": "v1", "kind": "Service",
+               "metadata": {"name": "s"}, "spec": {}}
+        resp = run(verify_policy(), svc, StaticVerifier())
+        assert resp.policy_response.rules == []
+
+
+class TestAttestations:
+    def _verifier(self, level="L3"):
+        v = StaticVerifier()
+        v.attest("ghcr.io/acme/app:v1", {
+            "predicateType": "https://slsa.dev/provenance/v0.2",
+            "predicate": {"buildLevel": level,
+                          "builder": {"id": "gha"}},
+        })
+        return v
+
+    def attest_policy(self, conditions):
+        return verify_policy(attestations=[{
+            "predicateType": "https://slsa.dev/provenance/v0.2",
+            "conditions": conditions,
+        }])
+
+    def test_conditions_pass(self):
+        policy = self.attest_policy([{"all": [
+            {"key": "{{ buildLevel }}", "operator": "Equals", "value": "L3"},
+            {"key": "{{ builder.id }}", "operator": "Equals", "value": "gha"},
+        ]}])
+        resp = run(policy, pod(), self._verifier())
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.PASS
+
+    def test_conditions_fail(self):
+        policy = self.attest_policy([{"all": [
+            {"key": "{{ buildLevel }}", "operator": "Equals", "value": "L3"},
+        ]}])
+        resp = run(policy, pod(), self._verifier(level="L1"))
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.FAIL
+        assert "attestation checks failed" in rule.message
+
+    def test_image_context_object(self):
+        # imageVerify.go:270: conditions see an ``image`` object
+        policy = self.attest_policy([{"all": [
+            {"key": "{{ image.tag }}", "operator": "Equals", "value": "v1"},
+            {"key": "{{ image.registry }}", "operator": "Equals",
+             "value": "ghcr.io"},
+        ]}])
+        resp = run(policy, pod(), self._verifier())
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.PASS
+
+    def test_missing_attestations_error(self):
+        policy = self.attest_policy([{"all": [
+            {"key": "{{ buildLevel }}", "operator": "Equals", "value": "L3"},
+        ]}])
+        resp = run(policy, pod(), StaticVerifier())
+        [rule] = resp.policy_response.rules
+        assert rule.status == RuleStatus.ERROR
+        assert not resp.successful
+
+
+class TestWebhookIntegration:
+    def make_server(self, verifier, action="enforce"):
+        cache = PolicyCache()
+        cache.add(verify_policy(action=action))
+        cluster = FakeCluster()
+        return WebhookServer(
+            policy_cache=cache, config=ConfigData(), client=cluster,
+            event_gen=EventGenerator(cluster), report_gen=ReportGenerator(),
+            registry=MetricsRegistry(), image_verifier=verifier,
+        )
+
+    def _review(self, resource):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "u1", "kind": {"kind": "Pod"},
+                "namespace": "default", "operation": "CREATE",
+                "object": resource,
+            },
+        }
+
+    def test_signed_pod_gets_digest_patch(self):
+        import base64
+        import json as json_mod
+
+        v = StaticVerifier()
+        v.sign("ghcr.io/acme/app:v1", DIGEST, key="k1")
+        server = self.make_server(v)
+        out = server.handle(MUTATING_WEBHOOK_PATH, self._review(pod()))
+        assert out["response"]["allowed"] is True
+        patches = json_mod.loads(
+            base64.b64decode(out["response"]["patch"]))
+        assert {"op": "replace", "path": "/spec/containers/0/image",
+                "value": f"ghcr.io/acme/app:v1@{DIGEST}"} in patches
+
+    def test_unsigned_pod_blocked_in_enforce(self):
+        server = self.make_server(StaticVerifier())
+        out = server.handle(MUTATING_WEBHOOK_PATH, self._review(pod()))
+        assert out["response"]["allowed"] is False
+        assert "image verification failed" in out["response"]["status"]["message"]
+
+    def test_unsigned_pod_allowed_in_audit(self):
+        server = self.make_server(StaticVerifier(), action="audit")
+        out = server.handle(MUTATING_WEBHOOK_PATH, self._review(pod()))
+        assert out["response"]["allowed"] is True
