@@ -301,6 +301,14 @@ class Rule:
     def has_verify_images(self) -> bool:
         return bool(self.verify_images)
 
+    def match_kinds(self) -> list[str]:
+        """policy_types.go MatchKinds: kinds across match.resources and
+        every match.any/all resource filter."""
+        kinds = list(self.match.resources.kinds)
+        for rf in list(self.match.any) + list(self.match.all):
+            kinds.extend(rf.resources.kinds)
+        return kinds
+
     @classmethod
     def from_dict(cls, d: dict) -> "Rule":
         return cls(

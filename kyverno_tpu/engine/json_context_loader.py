@@ -78,9 +78,10 @@ def _load_config_map(entry, policy_ctx: PolicyContext) -> None:
     name = substitute_all(ctx, entry.config_map.get("name", ""))
     namespace = substitute_all(ctx, entry.config_map.get("namespace", "")) or "default"
 
-    if policy_ctx.client is None:
+    source = policy_ctx.resource_cache or policy_ctx.client
+    if source is None:
         raise ContextLoadError("configmap client is not available")
-    obj = policy_ctx.client.get_configmap(namespace, name)
+    obj = source.get_configmap(namespace, name)
     if obj is None:
         raise ContextLoadError(
             f"failed to read configmap {namespace}/{name} from cache"

@@ -27,6 +27,8 @@ class PolicyContext:
     exclude_group_role: list[str] = field(default_factory=list)
     exclude_resource_func: Optional[Callable[[str, str, str], bool]] = None
     client: Any = None
+    resource_cache: Any = None  # pkg/resourcecache seam: cached listers for
+    # ConfigMap context entries; falls back to ``client`` when absent
     json_context: Context = field(default_factory=Context)
     namespace_labels: dict[str, str] = field(default_factory=dict)
 

@@ -64,7 +64,12 @@ class LeaderElector:
                     },
                 })
             except ConflictError:
-                return self._transition(False)
+                # another replica created the lease first; re-read to
+                # confirm holdership (it may still be us on a retry race)
+                lease = self._lease()
+                holder = ((lease or {}).get("spec") or {}).get(
+                    "holderIdentity", "")
+                return self._transition(holder == self.identity)
             return self._transition(True)
 
         spec = lease.get("spec") or {}

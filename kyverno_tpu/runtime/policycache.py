@@ -45,6 +45,21 @@ class PolicyCache:
         self._policies: dict[str, ClusterPolicy] = {}
         self._compiled = {}
         self._generation = 0
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        """fn(event, policy) fires after add/update ("SET") and remove
+        ("DELETE") — the informer-handler seam the reference's policy
+        controller and webhook config manager subscribe to
+        (policy_controller.go:143-150, configmanager.go:129-150)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def _fire(self, event: str, policy: ClusterPolicy) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(event, policy)
 
     @staticmethod
     def _key(policy: ClusterPolicy) -> str:
@@ -79,12 +94,14 @@ class PolicyCache:
                         ).append(key)
             self._generation += 1
             self._compiled.clear()
+        self._fire("SET", policy)
 
     def remove(self, policy: ClusterPolicy) -> None:
         with self._lock:
             self._remove_locked(self._key(policy))
             self._generation += 1
             self._compiled.clear()
+        self._fire("DELETE", policy)
 
     def update(self, policy: ClusterPolicy) -> None:
         self.add(policy)

@@ -1,0 +1,93 @@
+"""Resource cache: watch-maintained read-through listers per GVK.
+
+Mirrors /root/reference/pkg/resourcecache (main.go:17 ResourceCache,
+resourcecache.go:42 CreateGVKInformer): per-kind caches created on demand,
+kept in sync by the cluster watch stream when the client provides one
+(FakeCluster.watch; a RestClient deployment would drive this from a watch
+connection) and falling back to TTL resync otherwise. Used for the
+admission hot path's namespace-label lookups (server.go:521) and for
+ConfigMap context entries (jsonContext.go:189 loadConfigMap "from cache"),
+so steady-state admission does no synchronous API GETs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class _Entry:
+    __slots__ = ("resource", "stamp")
+
+    def __init__(self, resource: dict | None, stamp: float):
+        self.resource = resource          # None caches a confirmed absence
+        self.stamp = stamp
+
+
+class ResourceCache:
+    """pkg/resourcecache ResourceCache."""
+
+    def __init__(self, client, resync_s: float = 60.0):
+        self.client = client
+        self.resync_s = resync_s
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, _Entry] = {}
+        self._watching = False
+        self.lookups = 0
+        self.fetches = 0
+        if client is not None and hasattr(client, "watch"):
+            client.watch(self._on_event)
+            self._watching = True
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> tuple:
+        return (kind, namespace or "", name)
+
+    def _on_event(self, event: str, resource: dict) -> None:
+        meta = resource.get("metadata") or {}
+        key = self._key(resource.get("kind", ""), meta.get("namespace", ""),
+                        meta.get("name", ""))
+        with self._lock:
+            if key not in self._entries:
+                return  # only kinds already cached are maintained
+            if event == "DELETED":
+                self._entries[key] = _Entry(None, time.monotonic())
+            else:
+                self._entries[key] = _Entry(resource, time.monotonic())
+
+    def get(self, api_version: str, kind: str, namespace: str,
+            name: str) -> dict | None:
+        """Lister get: cache hit while watch-fresh (or within the resync
+        window), read-through to the client otherwise."""
+        self.lookups += 1
+        key = self._key(kind, namespace, name)
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and (
+                    self._watching or now - entry.stamp < self.resync_s):
+                return entry.resource
+        if self.client is None:
+            return None
+        self.fetches += 1
+        resource = self.client.get_resource(api_version, kind, namespace, name)
+        with self._lock:
+            self._entries[key] = _Entry(resource, now)
+        return resource
+
+    def get_namespace_labels(self, namespace: str) -> dict:
+        ns = self.get("v1", "Namespace", "", namespace)
+        if not ns:
+            return {}
+        return (ns.get("metadata") or {}).get("labels") or {}
+
+    def get_configmap(self, namespace: str, name: str) -> dict | None:
+        return self.get("v1", "ConfigMap", namespace, name)
+
+    def invalidate(self, kind: str = "", namespace: str = "",
+                   name: str = "") -> None:
+        with self._lock:
+            if not kind:
+                self._entries.clear()
+            else:
+                self._entries.pop(self._key(kind, namespace, name), None)

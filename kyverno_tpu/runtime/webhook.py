@@ -28,10 +28,12 @@ from ..engine.policy_context import PolicyContext
 from ..engine.response import RuleStatus
 from ..engine.validation import validate as engine_validate
 from ..policy.autogen import apply_defaults, generate_pod_controller_rules
+from ..policy.openapi import validate_policy_mutation
 from ..policy.validation import validate_policy
 from ..api.load import load_policy
 from . import metrics as metrics_mod
 from .config import ConfigData
+from .resourcecache import ResourceCache
 from .events import EventGenerator, events_for_engine_response
 from .policycache import PolicyCache, PolicyType
 from .reports import ReportGenerator
@@ -87,6 +89,8 @@ class WebhookServer:
         self.event_gen = event_gen
         self.report_gen = report_gen
         self.image_verifier = image_verifier or Verifier()
+        self.resource_cache = (ResourceCache(client)
+                               if client is not None else None)
         self.registry = registry or metrics_mod.registry()
         self.audit_handler = AuditHandler(self._process_audit)
         self.last_request_time = time.time()
@@ -160,16 +164,25 @@ class WebhookServer:
             pass
         namespace_labels = {}
         namespace = request.get("namespace", "")
-        if namespace and self.client is not None:
-            ns_obj = self.client.get_resource("v1", "Namespace", "", namespace)
-            if ns_obj:
-                namespace_labels = (ns_obj.get("metadata") or {}).get("labels") or {}
+        if namespace:
+            # cached lister, not a synchronous GET per admission
+            # (server.go:521 GetNamespaceSelectorsFromNamespaceLister)
+            if self.resource_cache is not None:
+                namespace_labels = self.resource_cache.get_namespace_labels(
+                    namespace)
+            elif self.client is not None:
+                ns_obj = self.client.get_resource(
+                    "v1", "Namespace", "", namespace)
+                if ns_obj:
+                    namespace_labels = (
+                        ns_obj.get("metadata") or {}).get("labels") or {}
         return PolicyContext(
             new_resource=resource,
             old_resource=request.get("oldObject") or {},
             admission_info=admission_info,
             exclude_group_role=self.config.get_exclude_group_role(),
             client=self.client,
+            resource_cache=self.resource_cache,
             json_context=ctx,
             namespace_labels=namespace_labels,
         )
@@ -219,10 +232,16 @@ class WebhookServer:
             PolicyType.VERIFY_IMAGES, kind, namespace)
         blocked_msgs: list[str] = []
         if verify_policies:
-            vctx = self._policy_context(request, resource)
+            # reuse the request's policy context (server.go:343 builds one
+            # per request); refresh image info on the mutated resource
+            pctx.new_resource = resource
+            try:
+                pctx.json_context.add_image_info(resource)
+            except Exception:
+                pass
             for policy in verify_policies:
-                vctx.policy = policy
-                resp = verify_and_patch_images(vctx, self.image_verifier)
+                pctx.policy = policy
+                resp = verify_and_patch_images(pctx, self.image_verifier)
                 engine_responses.append(resp)
                 patches.extend(resp.patches)
                 for rule in resp.policy_response.rules:
@@ -385,13 +404,17 @@ class WebhookServer:
         return _admission_response(uid, True, patches=patches)
 
     def _policy_validation(self, request: dict) -> dict:
-        """policyvalidation.go: structural validation gates admission."""
+        """policyvalidation.go: structural validation gates admission,
+        then mutate patterns are schema-checked against the kind schemas
+        (pkg/policy/validate.go -> openapi ValidatePolicyMutation)."""
         uid = request.get("uid", "")
         try:
             policy = load_policy(request.get("object") or {})
         except Exception as e:
             return _admission_response(uid, False, f"invalid policy: {e}")
         errors = validate_policy(policy)
+        if not errors:
+            errors = validate_policy_mutation(policy)
         if errors:
             return _admission_response(uid, False, "; ".join(errors))
         return _admission_response(uid, True)

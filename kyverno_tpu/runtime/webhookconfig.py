@@ -127,6 +127,230 @@ class Register:
                 config["apiVersion"], config["kind"], "", config["metadata"]["name"])
 
 
+# ---------------------------------------------------------------- narrowing
+
+# configmanager.go:693-704: *Options kinds map to fixed subresource GVRs
+_OPTIONS_GVR = {
+    "NodeProxyOptions": ("", "v1", "nodes/proxy"),
+    "PodAttachOptions": ("", "v1", "pods/attach"),
+    "PodExecOptions": ("", "v1", "pods/exec"),
+    "PodPortForwardOptions": ("", "v1", "pods/portforward"),
+    "PodProxyOptions": ("", "v1", "pods/proxy"),
+    "ServiceProxyOptions": ("", "v1", "services/proxy"),
+}
+
+# core/common kinds -> (group, version, resource); the reference resolves
+# these via the discovery client (configmanager.go:706 FindResource) — a
+# static table plus regular pluralization stands in for discovery here
+_KNOWN_GVR = {
+    "Pod": ("", "v1", "pods"),
+    "Service": ("", "v1", "services"),
+    "ConfigMap": ("", "v1", "configmaps"),
+    "Secret": ("", "v1", "secrets"),
+    "Namespace": ("", "v1", "namespaces"),
+    "Node": ("", "v1", "nodes"),
+    "ServiceAccount": ("", "v1", "serviceaccounts"),
+    "PersistentVolume": ("", "v1", "persistentvolumes"),
+    "PersistentVolumeClaim": ("", "v1", "persistentvolumeclaims"),
+    "Endpoints": ("", "v1", "endpoints"),
+    "LimitRange": ("", "v1", "limitranges"),
+    "ResourceQuota": ("", "v1", "resourcequotas"),
+    "Deployment": ("apps", "v1", "deployments"),
+    "DaemonSet": ("apps", "v1", "daemonsets"),
+    "StatefulSet": ("apps", "v1", "statefulsets"),
+    "ReplicaSet": ("apps", "v1", "replicasets"),
+    "Job": ("batch", "v1", "jobs"),
+    "CronJob": ("batch", "v1", "cronjobs"),
+    "Ingress": ("networking.k8s.io", "v1", "ingresses"),
+    "NetworkPolicy": ("networking.k8s.io", "v1", "networkpolicies"),
+    "HorizontalPodAutoscaler": ("autoscaling", "v1", "horizontalpodautoscalers"),
+    "PodDisruptionBudget": ("policy", "v1", "poddisruptionbudgets"),
+    "Role": ("rbac.authorization.k8s.io", "v1", "roles"),
+    "RoleBinding": ("rbac.authorization.k8s.io", "v1", "rolebindings"),
+    "ClusterRole": ("rbac.authorization.k8s.io", "v1", "clusterroles"),
+    "ClusterRoleBinding": ("rbac.authorization.k8s.io", "v1", "clusterrolebindings"),
+}
+
+
+def _pluralize(kind: str) -> str:
+    k = kind.lower()
+    if k.endswith(("s", "x", "z", "ch", "sh")):
+        return k + "es"
+    if k.endswith("y") and k[-2:-1] not in "aeiou":
+        return k[:-1] + "ies"
+    return k + "s"
+
+
+def _gvk_to_gvr(gvk: str) -> tuple[str, str, str]:
+    """GVK string (Kind / version/Kind / group/version/Kind) -> GVR tuple."""
+    parts = gvk.split("/")
+    kind = parts[-1]
+    if kind in _OPTIONS_GVR:
+        return _OPTIONS_GVR[kind]
+    if len(parts) == 3:
+        group, version = parts[0], parts[1]
+    elif len(parts) == 2:
+        group, version = "", parts[0]
+    else:
+        group, version = "", "*"
+    if kind in _KNOWN_GVR:
+        known = _KNOWN_GVR[kind]
+        if len(parts) == 1:
+            return known
+        return (group if len(parts) == 3 else known[0], version, known[2])
+    return (group, version, _pluralize(kind))
+
+
+def _match_kinds(rule) -> list[str]:
+    return rule.match_kinds()
+
+
+def _dedup(items: list[str]) -> list[str]:
+    seen: dict[str, None] = {}
+    for x in items:
+        seen.setdefault(x)
+    return list(seen)
+
+
+class _NarrowedWebhook:
+    """configmanager.go:455 webhook: GVK aggregation per (kind, failurePolicy)."""
+
+    def __init__(self, kind: str, failure_policy: str):
+        self.kind = kind
+        self.failure_policy = failure_policy
+        self.max_timeout = DEFAULT_WEBHOOK_TIMEOUT_S
+        self.groups: list[str] = []
+        self.versions: list[str] = []
+        self.resources: list[str] = []
+
+    def set_wildcard(self) -> None:
+        self.groups, self.versions, self.resources = ["*"], ["*"], ["*/*"]
+
+    def merge(self, policy, update_validate: bool) -> None:
+        """configmanager.go:667 mergeWebhook."""
+        matched: list[str] = []
+        for rule in policy.spec.rules:
+            if rule.has_generate():
+                # generate kinds land in both webhooks (configmanager.go:671)
+                matched.extend(_match_kinds(rule))
+                if rule.generation.kind:
+                    matched.append(rule.generation.kind)
+                continue
+            if ((update_validate and rule.has_validate())
+                    or (not update_validate
+                        and (rule.has_mutate() or rule.has_verify_images()))):
+                matched.extend(_match_kinds(rule))
+        for gvk in _dedup(matched):
+            g, v, r = _gvk_to_gvr(gvk)
+            self.groups.append(g)
+            self.versions.append(v)
+            self.resources.append(r)
+        self.groups = _dedup(self.groups)
+        self.versions = _dedup(self.versions)
+        self.resources = _dedup(self.resources)
+        t = policy.spec.webhook_timeout_seconds
+        if t is not None and t > self.max_timeout:
+            self.max_timeout = t
+
+    def rule(self) -> dict | None:
+        if not self.resources:
+            return None
+        return {
+            "apiGroups": self.groups,
+            "apiVersions": self.versions,
+            "resources": self.resources,
+            "operations": ["CREATE", "UPDATE", "DELETE", "CONNECT"],
+        }
+
+
+class WebhookConfigManager:
+    """configmanager.go:84 webhookConfigManager: recomputes the resource
+    webhook rule lists (mutate/validate x Ignore/Fail variants) from the
+    live policy set and rewrites the two resource configurations. Driven
+    by policy add/update/delete (sync(), the informer handlers of
+    configmanager.go:129-150)."""
+
+    def __init__(self, client, register: Register):
+        self.client = client
+        self.register = register
+        self._lock = threading.Lock()
+
+    def build_webhooks(self, policies) -> list[_NarrowedWebhook]:
+        """configmanager.go:465 buildWebhooks."""
+        mutate_ignore = _NarrowedWebhook("Mutating", "Ignore")
+        mutate_fail = _NarrowedWebhook("Mutating", "Fail")
+        validate_ignore = _NarrowedWebhook("Validating", "Ignore")
+        validate_fail = _NarrowedWebhook("Validating", "Fail")
+        out = [mutate_ignore, mutate_fail, validate_ignore, validate_fail]
+
+        if any("*" in _match_kinds(r) for p in policies for r in p.spec.rules):
+            for w in out:
+                w.set_wildcard()
+            return out
+
+        for p in policies:
+            has_validate = any(r.has_validate() for r in p.spec.rules)
+            has_generate = any(r.has_generate() for r in p.spec.rules)
+            has_mutate = any(r.has_mutate() for r in p.spec.rules)
+            has_verify = any(r.has_verify_images() for r in p.spec.rules)
+            ignore = p.spec.failure_policy == "Ignore"
+            if has_validate or has_generate:
+                (validate_ignore if ignore else validate_fail).merge(p, True)
+            if has_mutate or has_verify or has_generate:
+                (mutate_ignore if ignore else mutate_fail).merge(p, False)
+        return out
+
+    def sync(self, policies) -> None:
+        """Recompute and write both resource webhook configs
+        (configmanager.go:508 updateWebhookConfig)."""
+        with self._lock:
+            webhooks = self.build_webhooks(policies)
+            self._update_config(
+                "MutatingWebhookConfiguration", MUTATING_WEBHOOK_CONFIG,
+                "/mutate", [w for w in webhooks if w.kind == "Mutating"])
+            self._update_config(
+                "ValidatingWebhookConfiguration", VALIDATING_WEBHOOK_CONFIG,
+                "/validate", [w for w in webhooks if w.kind == "Validating"])
+
+    def _update_config(self, kind: str, name: str, path: str,
+                       webhooks) -> None:
+        reg = self.register
+        entries = []
+        for w in webhooks:
+            rule = w.rule()
+            if rule is None:
+                continue
+            suffix = "ignore" if w.failure_policy == "Ignore" else "fail"
+            entries.append({
+                "name": f"{name}-{suffix}.kyverno.svc",
+                "clientConfig": {
+                    "service": {
+                        "namespace": reg.service_namespace,
+                        "name": reg.service_name,
+                        "path": path,
+                    },
+                    "caBundle": reg.ca_bundle,
+                },
+                "rules": [rule],
+                "failurePolicy": w.failure_policy,
+                "timeoutSeconds": w.max_timeout,
+                "sideEffects": "NoneOnDryRun",
+                "admissionReviewVersions": ["v1"],
+            })
+        config = {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": kind,
+            "metadata": {"name": name},
+            "webhooks": entries,
+        }
+        existing = self.client.get_resource(
+            config["apiVersion"], kind, "", name)
+        if existing is None:
+            self.client.create_resource(config)
+        else:
+            self.client.update_resource(config)
+
+
 class Monitor:
     """monitor.go:41 Monitor: the webhook failure detector."""
 

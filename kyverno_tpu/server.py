@@ -12,6 +12,7 @@ Run: ``python -m kyverno_tpu.server`` (in-cluster) or construct
 
 from __future__ import annotations
 
+import logging
 import signal
 import threading
 import time
@@ -28,7 +29,12 @@ from .runtime.metrics import MetricsRegistry
 from .runtime.policycache import PolicyCache
 from .runtime.reports import ReportGenerator
 from .runtime.webhook import WebhookServer
-from .runtime.webhookconfig import CertRenewer, Monitor, Register
+from .runtime.webhookconfig import (
+    CertRenewer,
+    Monitor,
+    Register,
+    WebhookConfigManager,
+)
 
 BACKGROUND_SCAN_INTERVAL_S = 3600.0  # cmd/kyverno/main.go:94 default 1h
 
@@ -80,6 +86,7 @@ class Controller:
         ca = self.cert_renewer.ca_bundle() if self.cert_renewer else ""
         self.register = Register(self.client, ca_bundle=ca)
         self.monitor = Monitor(self.register, self.cert_renewer)
+        self.webhook_manager = WebhookConfigManager(self.client, self.register)
         self.generate_controller = GenerateController(self.client, {})
         self.elector = LeaderElector(
             self.client, namespace=namespace,
@@ -87,7 +94,61 @@ class Controller:
         )
         self._scan_thread: threading.Thread | None = None
         self._stop = threading.Event()
+        self._scan_kick = threading.Event()
+        self._loading_policies = False      # coalesce startup sync
+        self._webhook_sync_pending = False
         self._httpd = None
+
+        # policy-change reconciliation (policy_controller.go:541-573 +
+        # configmanager.go:129): cache changes re-narrow the webhooks and
+        # re-queue the background scan; cluster watch events feed the cache
+        # and prune reports for deleted policies/resources
+        self.policy_cache.add_listener(self._on_policy_change)
+        if hasattr(self.client, "watch"):
+            self.client.watch(self._on_cluster_event)
+        self.config.on_change(lambda *_: self.report_gen.reconcile())
+
+    # ---------------------------------------------------------- reconcile
+
+    def _sync_webhooks(self) -> None:
+        try:
+            self.webhook_manager.sync(self.policy_cache.all_policies())
+            self._webhook_sync_pending = False
+        except Exception:
+            # stale webhook rules mean missed admissions — log and retry
+            # on the next scan tick (the reference requeues via workqueue,
+            # configmanager.go:129-150)
+            logging.getLogger("kyverno.webhookconfig").exception(
+                "webhook config sync failed; will retry")
+            self._webhook_sync_pending = True
+
+    def _on_policy_change(self, event: str, policy) -> None:
+        if not self._loading_policies:
+            self._sync_webhooks()
+        if event == "DELETE":
+            self.report_gen.prune_policy(policy.name)
+            self.generate_controller.policies.pop(policy.name, None)
+        else:
+            self.generate_controller.policies[policy.name] = policy
+        self._scan_kick.set()
+
+    def _on_cluster_event(self, event: str, resource: dict) -> None:
+        """The informer seam: policy CRs reconcile the cache; resource
+        deletions prune their report rows (reportcontroller.go cleanup)."""
+        kind = resource.get("kind", "")
+        if kind in ("ClusterPolicy", "Policy"):
+            try:
+                policy = mutate_policy_for_autogen(load_policy(resource))
+            except Exception:
+                return
+            if event == "DELETED":
+                self.policy_cache.remove(policy)
+            else:
+                self.policy_cache.add(policy)
+        elif event == "DELETED":
+            meta = resource.get("metadata") or {}
+            self.report_gen.prune_resource(
+                kind, meta.get("namespace", ""), meta.get("name", ""))
 
     # ------------------------------------------------------------ policies
 
@@ -95,12 +156,17 @@ class Controller:
         """Sync the cache (and generate controller) from stored policies,
         applying the same defaults+autogen mutation the policy webhook does."""
         policies = {}
-        for kind in ("ClusterPolicy", "Policy"):
-            for doc in self.client.list_resource("kyverno.io/v1", kind):
-                policy = mutate_policy_for_autogen(load_policy(doc))
-                self.policy_cache.add(policy)
-                policies[policy.name] = policy
+        self._loading_policies = True   # one webhook sync for the batch
+        try:
+            for kind in ("ClusterPolicy", "Policy"):
+                for doc in self.client.list_resource("kyverno.io/v1", kind):
+                    policy = mutate_policy_for_autogen(load_policy(doc))
+                    self.policy_cache.add(policy)
+                    policies[policy.name] = policy
+        finally:
+            self._loading_policies = False
         self.generate_controller.policies = policies
+        self._sync_webhooks()
 
     def sync_config(self) -> None:
         cm = self.client.get_configmap(self.namespace, "kyverno")
@@ -130,7 +196,14 @@ class Controller:
         self.generate_controller.sync_from_cluster()
 
         def scan_loop():
-            while not self._stop.wait(BACKGROUND_SCAN_INTERVAL_S):
+            while not self._stop.is_set():
+                # interval tick OR a policy-change kick, whichever first
+                self._scan_kick.wait(BACKGROUND_SCAN_INTERVAL_S)
+                self._scan_kick.clear()
+                if self._stop.is_set():
+                    return
+                if self._webhook_sync_pending:
+                    self._sync_webhooks()
                 if self.elector.is_leader():
                     try:
                         self.run_background_scan()
@@ -152,6 +225,7 @@ class Controller:
 
     def stop(self) -> None:
         self._stop.set()
+        self._scan_kick.set()  # unblock the scan loop promptly
         self.webhook.stop()
         self.event_gen.stop()
         self.generate_controller.stop()

@@ -1,0 +1,131 @@
+"""Resource cache (pkg/resourcecache): zero synchronous GETs on the
+steady-state admission path, watch-driven freshness."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.resourcecache import ResourceCache
+from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH, WebhookServer
+
+
+class CountingCluster(FakeCluster):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.get_calls = 0
+
+    def get_resource(self, api_version, kind, namespace, name):
+        self.get_calls += 1
+        return super().get_resource(api_version, kind, namespace, name)
+
+
+NS_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "env-selector"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "env-rule",
+            "match": {"resources": {
+                "kinds": ["Pod"],
+                "namespaceSelector": {"matchLabels": {"env": "prod"}}}},
+            "validate": {"message": "no hostPID in prod",
+                         "pattern": {"spec": {"hostPID": "false"}}},
+        }],
+    },
+}
+
+
+def review(resource, namespace="default"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u", "kind": {"kind": "Pod"},
+                        "namespace": namespace, "operation": "CREATE",
+                        "object": resource}}
+
+
+def pod(namespace="default", host_pid=False):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": namespace},
+            "spec": {"hostPID": host_pid, "containers": [
+                {"name": "c", "image": "nginx:1.21"}]}}
+
+
+class TestResourceCache:
+    def test_read_through_then_cached(self):
+        cluster = CountingCluster([{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}}])
+        cache = ResourceCache(cluster)
+        assert cache.get_namespace_labels("prod") == {"env": "prod"}
+        base = cluster.get_calls
+        for _ in range(10):
+            assert cache.get_namespace_labels("prod") == {"env": "prod"}
+        assert cluster.get_calls == base  # zero GETs once cached
+
+    def test_watch_event_refreshes(self):
+        cluster = CountingCluster([{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}}])
+        cache = ResourceCache(cluster)
+        cache.get_namespace_labels("prod")
+        cluster.update_resource({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "staging"}}})
+        base = cluster.get_calls
+        assert cache.get_namespace_labels("prod") == {"env": "staging"}
+        assert cluster.get_calls == base  # updated via watch, not a GET
+
+    def test_absence_cached(self):
+        cluster = CountingCluster()
+        cache = ResourceCache(cluster)
+        assert cache.get("v1", "Namespace", "", "ghost") is None
+        base = cluster.get_calls
+        assert cache.get("v1", "Namespace", "", "ghost") is None
+        assert cluster.get_calls == base
+
+    def test_deleted_resource_drops(self):
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "prod", "labels": {"env": "prod"}}}
+        cluster = CountingCluster([ns])
+        cache = ResourceCache(cluster)
+        assert cache.get_namespace_labels("prod")
+        cluster.delete_resource("v1", "Namespace", "", "prod")
+        assert cache.get_namespace_labels("prod") == {}
+
+
+class TestAdmissionHotPath:
+    def test_steady_state_admission_does_no_gets(self):
+        cluster = CountingCluster([{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}}])
+        cache = PolicyCache()
+        cache.add(load_policy(NS_POLICY))
+        server = WebhookServer(policy_cache=cache, client=cluster)
+
+        out = server.handle(VALIDATING_WEBHOOK_PATH,
+                            review(pod("prod", host_pid=True), "prod"))
+        assert out["response"]["allowed"] is False  # selector matched
+
+        base = cluster.get_calls
+        for _ in range(20):
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("prod"), "prod"))
+            assert out["response"]["allowed"] is True
+        assert cluster.get_calls == base  # zero synchronous GETs steady-state
+
+    def test_namespace_label_change_visible(self):
+        cluster = CountingCluster([{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}}])
+        cache = PolicyCache()
+        cache.add(load_policy(NS_POLICY))
+        server = WebhookServer(policy_cache=cache, client=cluster)
+        out = server.handle(VALIDATING_WEBHOOK_PATH,
+                            review(pod("prod", host_pid=True), "prod"))
+        assert out["response"]["allowed"] is False
+        # namespace drops the selector label -> rule no longer matches
+        cluster.update_resource({
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "dev"}}})
+        out = server.handle(VALIDATING_WEBHOOK_PATH,
+                            review(pod("prod", host_pid=True), "prod"))
+        assert out["response"]["allowed"] is True

@@ -1,0 +1,198 @@
+"""Dynamic per-policy webhook narrowing (configmanager.go:455-757) and
+policy-change reconciliation (policy_controller.go:541-573)."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.webhookconfig import (
+    MUTATING_WEBHOOK_CONFIG,
+    VALIDATING_WEBHOOK_CONFIG,
+    Register,
+    WebhookConfigManager,
+    _gvk_to_gvr,
+)
+from kyverno_tpu.server import Controller
+
+
+def policy(name, kinds=("Pod",), action="validate", failure_policy="Fail",
+           timeout=None, generate_kind=None):
+    rule = {"name": f"{name}-r", "match": {"resources": {"kinds": list(kinds)}}}
+    if action == "validate":
+        rule["validate"] = {"pattern": {"metadata": {"name": "?*"}}}
+    elif action == "mutate":
+        rule["mutate"] = {"patchStrategicMerge": {"metadata": {
+            "labels": {"+(x)": "y"}}}}
+    elif action == "generate":
+        rule["generate"] = {"apiVersion": "v1", "kind": generate_kind,
+                            "name": "g", "namespace": "default",
+                            "data": {"spec": {}}}
+    spec = {"rules": [rule], "failurePolicy": failure_policy}
+    if timeout is not None:
+        spec["webhookTimeoutSeconds"] = timeout
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": spec,
+    })
+
+
+def test_gvk_to_gvr():
+    assert _gvk_to_gvr("Pod") == ("", "v1", "pods")
+    assert _gvk_to_gvr("apps/v1/Deployment") == ("apps", "v1", "deployments")
+    assert _gvk_to_gvr("v1/Pod") == ("", "v1", "pods")
+    assert _gvk_to_gvr("NetworkPolicy") == (
+        "networking.k8s.io", "v1", "networkpolicies")
+    assert _gvk_to_gvr("PodExecOptions") == ("", "v1", "pods/exec")
+    assert _gvk_to_gvr("MyCustomThing") == ("", "*", "mycustomthings")
+
+
+class TestBuildWebhooks:
+    def mgr(self):
+        client = FakeCluster()
+        return WebhookConfigManager(client, Register(client)), client
+
+    def test_pod_only_policy_narrows_to_pods(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([policy("p1", kinds=("Pod",))])
+        validate_fail = next(w for w in hooks
+                             if w.kind == "Validating"
+                             and w.failure_policy == "Fail")
+        assert validate_fail.rule()["resources"] == ["pods"]
+        # no mutate rules at all -> no mutate webhook entry
+        mutate_fail = next(w for w in hooks
+                           if w.kind == "Mutating" and w.failure_policy == "Fail")
+        assert mutate_fail.rule() is None
+
+    def test_second_policy_widens(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([
+            policy("p1", kinds=("Pod",)),
+            policy("p2", kinds=("apps/v1/Deployment",)),
+        ])
+        validate_fail = next(w for w in hooks
+                             if w.kind == "Validating"
+                             and w.failure_policy == "Fail")
+        rule = validate_fail.rule()
+        assert set(rule["resources"]) == {"pods", "deployments"}
+        assert set(rule["apiGroups"]) == {"", "apps"}
+
+    def test_failure_policy_variants_split(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([
+            policy("p1", kinds=("Pod",), failure_policy="Ignore"),
+            policy("p2", kinds=("Service",), failure_policy="Fail"),
+        ])
+        ignore = next(w for w in hooks if w.kind == "Validating"
+                      and w.failure_policy == "Ignore")
+        fail = next(w for w in hooks if w.kind == "Validating"
+                    and w.failure_policy == "Fail")
+        assert ignore.rule()["resources"] == ["pods"]
+        assert fail.rule()["resources"] == ["services"]
+
+    def test_wildcard_policy_forces_wide_open(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([
+            policy("p1", kinds=("Pod",)),
+            policy("pw", kinds=("*",)),
+        ])
+        for w in hooks:
+            assert w.rule()["resources"] == ["*/*"]
+
+    def test_mutate_policy_populates_mutating_webhook(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([policy("m1", kinds=("Pod",),
+                                           action="mutate")])
+        mutate_fail = next(w for w in hooks if w.kind == "Mutating"
+                           and w.failure_policy == "Fail")
+        assert mutate_fail.rule()["resources"] == ["pods"]
+
+    def test_generate_kinds_in_both_webhooks(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([policy(
+            "g1", kinds=("Namespace",), action="generate",
+            generate_kind="NetworkPolicy")])
+        for w in hooks:
+            if w.failure_policy == "Fail":
+                assert set(w.rule()["resources"]) == {
+                    "namespaces", "networkpolicies"}
+
+    def test_webhook_timeout_takes_max(self):
+        mgr, _ = self.mgr()
+        hooks = mgr.build_webhooks([
+            policy("p1", kinds=("Pod",), timeout=25),
+            policy("p2", kinds=("Service",), timeout=12),
+        ])
+        validate_fail = next(w for w in hooks if w.kind == "Validating"
+                             and w.failure_policy == "Fail")
+        assert validate_fail.max_timeout == 25
+
+    def test_sync_writes_configs(self):
+        mgr, client = self.mgr()
+        mgr.sync([policy("p1", kinds=("Pod",))])
+        cfg = client.get_resource("admissionregistration.k8s.io/v1",
+                                  "ValidatingWebhookConfiguration", "",
+                                  VALIDATING_WEBHOOK_CONFIG)
+        assert cfg is not None
+        [entry] = cfg["webhooks"]
+        assert entry["rules"][0]["resources"] == ["pods"]
+        mcfg = client.get_resource("admissionregistration.k8s.io/v1",
+                                   "MutatingWebhookConfiguration", "",
+                                   MUTATING_WEBHOOK_CONFIG)
+        assert mcfg is not None and mcfg["webhooks"] == []
+
+
+class TestPolicyChangeReconciliation:
+    def test_policy_cr_create_updates_cache_and_webhooks(self):
+        cluster = FakeCluster()
+        controller = Controller(client=cluster)
+        # a policy CR appears in the cluster (as if admitted by the webhook)
+        cluster.create_resource(policy("p1", kinds=("Pod",)).raw)
+        cached = controller.policy_cache.all_policies()
+        assert [p.name for p in cached] == ["p1"]
+        cfg = cluster.get_resource("admissionregistration.k8s.io/v1",
+                                   "ValidatingWebhookConfiguration", "",
+                                   VALIDATING_WEBHOOK_CONFIG)
+        # Pod + the autogen pod-controller kinds, nothing else
+        assert set(cfg["webhooks"][0]["rules"][0]["resources"]) == {
+            "pods", "deployments", "daemonsets", "statefulsets", "jobs",
+            "cronjobs"}
+        # a Service policy widens the narrowed rules without restart
+        cluster.create_resource(policy("p2", kinds=("Service",)).raw)
+        cfg = cluster.get_resource("admissionregistration.k8s.io/v1",
+                                   "ValidatingWebhookConfiguration", "",
+                                   VALIDATING_WEBHOOK_CONFIG)
+        assert "services" in cfg["webhooks"][0]["rules"][0]["resources"]
+
+    def test_scan_sees_policy_added_after_start(self):
+        cluster = FakeCluster()
+        controller = Controller(client=cluster)
+        cluster.create_resource({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx:latest"}]},
+        })
+        doc = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "no-latest"},
+            "spec": {"background": True, "rules": [{
+                "name": "no-latest",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"pattern": {"spec": {"containers": [
+                    {"image": "!*:latest"}]}}},
+            }]},
+        }
+        cluster.create_resource(doc)
+        assert controller._scan_kick.is_set()  # scan re-queued
+        result = controller.run_background_scan()
+        assert result.violations >= 1
+
+    def test_policy_delete_prunes_reports(self):
+        cluster = FakeCluster()
+        controller = Controller(client=cluster)
+        doc = policy("p1", kinds=("Pod",)).raw
+        cluster.create_resource(doc)
+        controller.report_gen.add_result(
+            namespace="default", policy="p1", rule="p1-r",
+            kind="Pod", name="x", status="fail",
+        ) if hasattr(controller.report_gen, "add_result") else None
+        cluster.delete_resource("kyverno.io/v1", "ClusterPolicy", "", "p1")
+        assert controller.policy_cache.all_policies() == []

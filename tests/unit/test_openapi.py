@@ -1,0 +1,120 @@
+"""OpenAPI schema validation (pkg/openapi/validation.go semantics)."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.policy.openapi import (
+    register_schema,
+    validate_policy_mutation,
+    validate_resource,
+)
+from kyverno_tpu.runtime.webhook import (
+    POLICY_VALIDATING_WEBHOOK_PATH,
+    WebhookServer,
+)
+
+
+def mutate_policy(pattern, kinds=("Pod",)):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "m"},
+        "spec": {"rules": [{
+            "name": "m-r",
+            "match": {"resources": {"kinds": list(kinds)}},
+            "mutate": {"patchStrategicMerge": pattern},
+        }]},
+    })
+
+
+class TestValidateResource:
+    def test_valid_pod(self):
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p", "labels": {"a": "b"}},
+               "spec": {"containers": [{
+                   "name": "c", "image": "nginx:1.21",
+                   "resources": {"requests": {"memory": "64Mi"}},
+                   "ports": [{"containerPort": 80}]}]}}
+        assert validate_resource(pod) == []
+
+    def test_unknown_field(self):
+        pod = {"kind": "Pod", "spec": {"containers": [
+            {"name": "c", "imagePullPolice": "Always"}]}}
+        errs = validate_resource(pod)
+        assert any("imagePullPolice" in e and "unknown field" in e
+                   for e in errs)
+
+    def test_wrong_type(self):
+        pod = {"kind": "Pod", "spec": {"hostNetwork": "yes"}}
+        errs = validate_resource(pod)
+        assert any("hostNetwork" in e and "boolean" in e for e in errs)
+
+    def test_unknown_kind_skipped(self):
+        assert validate_resource({"kind": "MyCRD", "whatever": 1}) == []
+
+    def test_registered_schema(self):
+        from kyverno_tpu.policy.openapi import STRING, obj
+
+        register_schema("Gadget", obj({"kind": STRING, "apiVersion": STRING,
+                                       "metadata": obj(open_=True),
+                                       "size": STRING}))
+        assert validate_resource({"kind": "Gadget", "size": "big"}) == []
+        errs = validate_resource({"kind": "Gadget", "size": 3})
+        assert any("size" in e for e in errs)
+
+
+class TestValidatePolicyMutation:
+    def test_valid_mutation_accepted(self):
+        policy = mutate_policy({"metadata": {"labels": {"+(team)": "x"}}})
+        assert validate_policy_mutation(policy) == []
+
+    def test_schema_invalid_mutation_rejected(self):
+        # writes a misspelled container field -> schema error
+        policy = mutate_policy({"spec": {"containers": [
+            {"name": "c", "imagePullPolice": "Always"}]}})
+        errs = validate_policy_mutation(policy)
+        assert errs and "imagePullPolice" in errs[0]
+
+    def test_wrong_type_mutation_rejected(self):
+        policy = mutate_policy({"spec": {"hostNetwork": "true"}})
+        errs = validate_policy_mutation(policy)
+        assert errs and "hostNetwork" in errs[0]
+
+    def test_unknown_kind_mutation_skipped(self):
+        policy = mutate_policy({"spec": {"anything": 1}}, kinds=("MyCRD",))
+        assert validate_policy_mutation(policy) == []
+
+
+class TestPolicyValidationWebhook:
+    def _review(self, doc):
+        return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+                "request": {"uid": "u", "kind": {"kind": "ClusterPolicy"},
+                            "operation": "CREATE", "object": doc}}
+
+    def test_schema_invalid_policy_blocked(self):
+        server = WebhookServer()
+        doc = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "bad-mutate"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "spec": {"hostNetwork": "not-a-bool"}}},
+            }]},
+        }
+        out = server.handle(POLICY_VALIDATING_WEBHOOK_PATH, self._review(doc))
+        assert out["response"]["allowed"] is False
+        assert "hostNetwork" in out["response"]["status"]["message"]
+
+    def test_valid_policy_allowed(self):
+        server = WebhookServer()
+        doc = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "good-mutate"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "metadata": {"labels": {"+(team)": "x"}}}},
+            }]},
+        }
+        out = server.handle(POLICY_VALIDATING_WEBHOOK_PATH, self._review(doc))
+        assert out["response"]["allowed"] is True
