@@ -1,0 +1,148 @@
+"""Admission micro-batcher: the host batching shim of the TPU tier.
+
+The reference serves one goroutine per admission request
+(pkg/webhooks/server.go:233); the TPU-native analogue batches concurrent
+admission resources into one device evaluation (BASELINE.json north star,
+SURVEY.md section 7 step 5 "batch scheduler"): requests arriving within a
+micro-batch window are flattened together, scored as one policy x resource
+matrix, and their verdict rows scattered back to the waiting handlers.
+
+The device acts as a *screen*: a resource whose row is all
+PASS/SKIP/NOT_APPLICABLE is admitted without touching the CPU engine (the
+common case); any FAIL/ERROR/HOST cell routes that one resource to the
+full oracle for faithful rule messages and context-dependent semantics.
+Wrong-way cost is therefore latency only, never correctness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..models import Verdict
+
+CLEAN = "clean"          # every cell PASS/SKIP/NOT_APPLICABLE
+ATTENTION = "attention"  # some cell FAIL/ERROR/HOST -> oracle lane
+
+
+def verdict_to_status(verdict: Verdict):
+    """Device verdict -> RuleStatus (None for non-statuses like HOST)."""
+    from ..engine.response import RuleStatus
+
+    return {
+        Verdict.PASS: RuleStatus.PASS,
+        Verdict.FAIL: RuleStatus.FAIL,
+        Verdict.SKIP: RuleStatus.SKIP,
+        Verdict.ERROR: RuleStatus.ERROR,
+    }.get(verdict)
+
+
+class _Bucket:
+    def __init__(self, cps):
+        self.cps = cps
+        self.items: list[tuple[dict, Future]] = []
+
+
+class AdmissionBatcher:
+    """Micro-batching device screen over policy_cache.compiled() sets."""
+
+    def __init__(self, policy_cache, window_s: float = 0.004,
+                 max_batch: int = 512):
+        self.policy_cache = policy_cache
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._lock = threading.Condition()
+        self._buckets: dict[tuple, _Bucket] = {}
+        self._stopped = False
+        self._worker = threading.Thread(target=self._run, name="adm-batch",
+                                        daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------ enqueue
+
+    def screen(self, ptype, kind: str, namespace: str, resource: dict,
+               timeout_s: float = 2.0):
+        """Returns (CLEAN | ATTENTION, [(policy, rule, Verdict), ...]).
+
+        On any failure — timeout, compile error, device error — returns
+        (ATTENTION, []) so the caller takes the oracle lane."""
+        try:
+            cps = self.policy_cache.compiled(ptype, kind, namespace)
+        except Exception:
+            return ATTENTION, []
+        if not cps.policies:
+            return CLEAN, []
+        fut: Future = Future()
+        with self._lock:
+            if self._stopped:
+                return ATTENTION, []
+            key = (int(ptype), kind, namespace, id(cps))
+            bucket = self._buckets.get(key)
+            if bucket is None or bucket.cps is not cps:
+                bucket = self._buckets[key] = _Bucket(cps)
+            bucket.items.append((resource, fut))
+            self._lock.notify()
+        try:
+            return fut.result(timeout=timeout_s)
+        except Exception:
+            return ATTENTION, []
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._stopped and not any(
+                        b.items for b in self._buckets.values()):
+                    self._lock.wait()
+                if self._stopped:
+                    for b in self._buckets.values():
+                        for _, fut in b.items:
+                            fut.set_result((ATTENTION, []))
+                    return
+            # micro-batch window: let concurrent requests pile in
+            time.sleep(self.window_s)
+            with self._lock:
+                work = [(b.cps, b.items[:self.max_batch])
+                        for b in self._buckets.values() if b.items]
+                for b in self._buckets.values():
+                    del b.items[:self.max_batch]
+                # drained buckets go away: bucket keys embed id(cps), so a
+                # policy-cache generation change would otherwise leak the
+                # old CompiledPolicySet forever
+                self._buckets = {k: b for k, b in self._buckets.items()
+                                 if b.items}
+            for cps, items in work:
+                self._flush(cps, items)
+
+    def _flush(self, cps, items) -> None:
+        try:
+            resources = [r for r, _ in items]
+            batch = cps.flatten(resources)
+            verdicts = np.asarray(cps.evaluate_device(batch))
+        except Exception:
+            for _, fut in items:
+                if not fut.done():
+                    fut.set_result((ATTENTION, []))
+            return
+        for b, (_, fut) in enumerate(items):
+            row = []
+            clean = True
+            for ref in cps.rule_refs:
+                v = Verdict(verdicts[b, ref.rule_index])
+                if v is Verdict.NOT_APPLICABLE:
+                    continue
+                row.append((ref.policy.name, ref.rule.name, v))
+                if v not in (Verdict.PASS, Verdict.SKIP):
+                    clean = False
+            if not fut.done():
+                fut.set_result((CLEAN if clean else ATTENTION, row))
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._lock.notify()
+        self._worker.join(timeout=2.0)

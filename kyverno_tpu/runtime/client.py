@@ -60,6 +60,9 @@ class FakeCluster(Client):
         self._store: dict[tuple[str, str, str], dict] = {}
         self._watchers: list = []
         self._rv = 0
+        # RBAC for SelfSubjectAccessReview: (verb, resource) pairs the
+        # controller is NOT allowed; default allow-all
+        self.deny_access: set[tuple[str, str]] = set()
         for r in resources or []:
             self.create_resource(r)
 
@@ -83,6 +86,15 @@ class FakeCluster(Client):
             ]
 
     def create_resource(self, resource):
+        if resource.get("kind") == "SelfSubjectAccessReview":
+            # the API server answers these inline, nothing is stored
+            attrs = ((resource.get("spec") or {})
+                     .get("resourceAttributes") or {})
+            allowed = (attrs.get("verb", ""),
+                       attrs.get("resource", "")) not in self.deny_access
+            out = copy.deepcopy(resource)
+            out["status"] = {"allowed": allowed}
+            return out
         with self._lock:
             key = self._key(resource)
             if key in self._store:

@@ -17,11 +17,13 @@ import time
 
 
 class _Entry:
-    __slots__ = ("resource", "stamp")
+    __slots__ = ("resource", "stamp", "pending")
 
-    def __init__(self, resource: dict | None, stamp: float):
+    def __init__(self, resource: dict | None, stamp: float,
+                 pending: bool = False):
         self.resource = resource          # None caches a confirmed absence
         self.stamp = stamp
+        self.pending = pending            # read-through fetch in flight
 
 
 class ResourceCache:
@@ -64,16 +66,27 @@ class ResourceCache:
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None and (
+            if entry is not None and not entry.pending and (
                     self._watching or now - entry.stamp < self.resync_s):
                 return entry.resource
+            # reserve the key BEFORE fetching so a watch event arriving
+            # while the GET is in flight is captured (and wins below)
+            pending = _Entry(None, now, pending=True)
+            self._entries[key] = pending
         if self.client is None:
+            with self._lock:
+                if self._entries.get(key) is pending:
+                    del self._entries[key]
             return None
         self.fetches += 1
         resource = self.client.get_resource(api_version, kind, namespace, name)
         with self._lock:
-            self._entries[key] = _Entry(resource, now)
-        return resource
+            current = self._entries.get(key)
+            if current is pending:
+                self._entries[key] = _Entry(resource, now)
+                return resource
+            # a watch event replaced the reservation: it is fresher
+            return current.resource if current is not None else resource
 
     def get_namespace_labels(self, namespace: str) -> dict:
         ns = self.get("v1", "Namespace", "", namespace)

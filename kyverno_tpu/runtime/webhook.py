@@ -31,6 +31,7 @@ from ..policy.autogen import apply_defaults, generate_pod_controller_rules
 from ..policy.openapi import validate_policy_mutation
 from ..policy.validation import validate_policy
 from ..api.load import load_policy
+from . import batch as batch_mod
 from . import metrics as metrics_mod
 from .config import ConfigData
 from .resourcecache import ResourceCache
@@ -80,10 +81,12 @@ class WebhookServer:
                  config: ConfigData | None = None, client=None,
                  event_gen: EventGenerator | None = None,
                  report_gen: ReportGenerator | None = None,
-                 registry=None, image_verifier=None):
+                 registry=None, image_verifier=None,
+                 admission_batcher=None):
         from ..engine.image_verify import Verifier
 
         self.policy_cache = policy_cache or PolicyCache()
+        self.admission_batcher = admission_batcher
         self.config = config or ConfigData()
         self.client = client
         self.event_gen = event_gen
@@ -269,6 +272,47 @@ class WebhookServer:
                     resp, self.config.generate_success_events()))
         return _admission_response(uid, True, patches=patches)
 
+    def _record_screen_results(self, row, resource: dict, kind: str,
+                               request: dict) -> None:
+        """Metrics + report rows for a device-screened admission, matching
+        what the oracle loop records for passing resources."""
+        from ..engine.response import (
+            EngineResponse,
+            PolicyResponse,
+            PolicySpecSummary,
+            ResourceSpec,
+            RuleResponse,
+            RuleType,
+        )
+
+        meta = resource.get("metadata") or {}
+        per_policy: dict[str, EngineResponse] = {}
+        for policy_name, rule_name, verdict in row:
+            status = batch_mod.verdict_to_status(verdict)
+            if status is None:
+                continue
+            metrics_mod.record_policy_results(
+                self.registry, policy_name, rule_name, status.value,
+                validation_mode="enforce", resource_kind=kind,
+                request_operation=request.get("operation", "CREATE"))
+            if self.report_gen is None:
+                continue
+            resp = per_policy.get(policy_name)
+            if resp is None:
+                resp = per_policy[policy_name] = EngineResponse(
+                    policy_response=PolicyResponse(
+                        policy=PolicySpecSummary(name=policy_name),
+                        resource=ResourceSpec(
+                            kind=resource.get("kind", ""),
+                            api_version=resource.get("apiVersion", ""),
+                            namespace=meta.get("namespace", ""),
+                            name=meta.get("name", ""))))
+            resp.policy_response.rules.append(RuleResponse(
+                name=rule_name, type=RuleType.VALIDATION, status=status))
+        for resp in per_policy.values():
+            if self.report_gen is not None:
+                self.report_gen.add(resp)
+
     def _resource_validation(self, request: dict) -> dict:
         """server.go:476 resourceValidation: enforce inline, audit async,
         then trigger generate policies."""
@@ -280,22 +324,36 @@ class WebhookServer:
         enforce = self.policy_cache.get_policies(
             PolicyType.VALIDATE_ENFORCE, kind, namespace)
         blocked_msgs: list[str] = []
-        pctx = self._policy_context(request, resource)
-        for policy in enforce:
-            pctx.policy = policy
-            resp = engine_validate(pctx)
-            for rule in resp.policy_response.rules:
-                metrics_mod.record_policy_results(
-                    self.registry, policy.name, rule.name, rule.status.value,
-                    validation_mode="enforce", resource_kind=kind,
-                    request_operation=request.get("operation", "CREATE"))
-                if rule.status in (RuleStatus.FAIL, RuleStatus.ERROR):
-                    blocked_msgs.append(
-                        f"policy {policy.name}/{rule.name}: {rule.message}")
-            if self.event_gen is not None:
-                self.event_gen.add(*events_for_engine_response(resp))
-            if self.report_gen is not None:
-                self.report_gen.add(resp)
+
+        # device screen (runtime/batch.py): micro-batched TPU evaluation;
+        # an all-green row admits without touching the CPU engine, anything
+        # else drops to the oracle loop below for faithful messages
+        screened_clean = False
+        if enforce and self.admission_batcher is not None:
+            status, row = self.admission_batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, kind, namespace, resource)
+            if status == batch_mod.CLEAN:
+                screened_clean = True
+                self._record_screen_results(row, resource, kind, request)
+
+        if enforce and not screened_clean:
+            pctx = self._policy_context(request, resource)
+            for policy in enforce:
+                pctx.policy = policy
+                resp = engine_validate(pctx)
+                for rule in resp.policy_response.rules:
+                    metrics_mod.record_policy_results(
+                        self.registry, policy.name, rule.name,
+                        rule.status.value,
+                        validation_mode="enforce", resource_kind=kind,
+                        request_operation=request.get("operation", "CREATE"))
+                    if rule.status in (RuleStatus.FAIL, RuleStatus.ERROR):
+                        blocked_msgs.append(
+                            f"policy {policy.name}/{rule.name}: {rule.message}")
+                if self.event_gen is not None:
+                    self.event_gen.add(*events_for_engine_response(resp))
+                if self.report_gen is not None:
+                    self.report_gen.add(resp)
 
         # a blocked request is returned BEFORE audit/generate side effects
         # (server.go:553-563)
@@ -415,6 +473,12 @@ class WebhookServer:
         errors = validate_policy(policy)
         if not errors:
             errors = validate_policy_mutation(policy)
+        if not errors:
+            # generate policies the controller cannot execute are rejected
+            # (policy/generate/validate.go:102 canIGenerate)
+            from .auth import can_i_generate
+
+            errors = can_i_generate(policy, self.client)
         if errors:
             return _admission_response(uid, False, "; ".join(errors))
         return _admission_response(uid, True)

@@ -13,13 +13,16 @@ Run: ``python -m kyverno_tpu.server`` (in-cluster) or construct
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
 import time
 
 from .api.load import load_policy
 from .policy.autogen import mutate_policy_for_autogen
+from .runtime import migrations
 from .runtime.background import BackgroundScanner
+from .runtime.batch import AdmissionBatcher
 from .runtime.client import Client, FakeCluster, RestClient, RestConfig
 from .runtime.config import ConfigData
 from .runtime.events import EventGenerator
@@ -78,10 +81,17 @@ class Controller:
         self.event_gen = EventGenerator(self.client)
         self.report_gen = ReportGenerator(self.client)
         self.cert_renewer = CertRenewer(self.client) if enable_tls else None
+        # the TPU device screen for enforce admissions (runtime/batch.py);
+        # opt-in: it trades a micro-batch window of latency for device
+        # throughput, the right call when the chip is local to the host
+        self.admission_batcher = (
+            AdmissionBatcher(self.policy_cache)
+            if os.environ.get("KTPU_ADMISSION_BATCH") == "1" else None)
         self.webhook = WebhookServer(
             policy_cache=self.policy_cache, config=self.config,
             client=self.client, event_gen=self.event_gen,
             report_gen=self.report_gen, registry=self.registry,
+            admission_batcher=self.admission_batcher,
         )
         ca = self.cert_renewer.ca_bundle() if self.cert_renewer else ""
         self.register = Register(self.client, ca_bundle=ca)
@@ -192,6 +202,7 @@ class Controller:
         """Leader-only: webhook registration, generate controller,
         background scan loop (main.go:480-486,503)."""
         self.register.register()
+        migrations.run_all(self.client, self.namespace)
         self.generate_controller.run()
         self.generate_controller.sync_from_cluster()
 
@@ -226,6 +237,8 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self._scan_kick.set()  # unblock the scan loop promptly
+        if self.admission_batcher is not None:
+            self.admission_batcher.stop()
         self.webhook.stop()
         self.event_gen.stop()
         self.generate_controller.stop()

@@ -1,0 +1,147 @@
+"""Admission micro-batcher: device screen + oracle fallback lane."""
+
+import threading
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models import Verdict
+from kyverno_tpu.runtime.batch import ATTENTION, CLEAN, AdmissionBatcher
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH, WebhookServer
+
+ENFORCE = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }],
+    },
+}
+
+
+def pod(image, name="p"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": image}]}}
+
+
+def review(resource):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u", "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+
+
+def make_batcher():
+    cache = PolicyCache()
+    cache.add(load_policy(ENFORCE))
+    return AdmissionBatcher(cache, window_s=0.002), cache
+
+
+class TestBatcher:
+    def test_clean_resource_screens_clean(self):
+        batcher, _ = make_batcher()
+        try:
+            status, row = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:1.21"))
+            assert status == CLEAN
+            assert row == [("disallow-latest-tag", "validate-image-tag",
+                            Verdict.PASS)]
+        finally:
+            batcher.stop()
+
+    def test_violating_resource_needs_attention(self):
+        batcher, _ = make_batcher()
+        try:
+            status, row = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:latest"))
+            assert status == ATTENTION
+            assert (("disallow-latest-tag", "validate-image-tag",
+                     Verdict.FAIL) in row)
+        finally:
+            batcher.stop()
+
+    def test_no_policies_is_clean(self):
+        batcher = AdmissionBatcher(PolicyCache(), window_s=0.001)
+        try:
+            status, row = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:1.21"))
+            assert (status, row) == (CLEAN, [])
+        finally:
+            batcher.stop()
+
+    def test_concurrent_requests_share_one_device_eval(self):
+        batcher, cache = make_batcher()
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        evals = []
+        orig = cps.evaluate_device
+
+        def counting(batch):
+            evals.append(batch.n)
+            return orig(batch)
+
+        cps.evaluate_device = counting
+        try:
+            results = [None] * 16
+            barrier = threading.Barrier(16)
+
+            def worker(i):
+                barrier.wait()
+                results[i] = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                    pod("nginx:1.21", name=f"p{i}"))
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert all(s == CLEAN for s, _ in results)
+            # 16 concurrent requests coalesced into very few device batches
+            assert sum(evals) == 16
+            assert len(evals) <= 4, evals
+        finally:
+            batcher.stop()
+
+
+class TestWebhookScreenPath:
+    def make_server(self):
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        batcher = AdmissionBatcher(cache, window_s=0.002)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        return server, batcher
+
+    def test_clean_pod_admitted_via_screen(self):
+        server, batcher = self.make_server()
+        try:
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:1.21")))
+            assert out["response"]["allowed"] is True
+            # the screen recorded the PASS result in metrics
+            assert "kyverno_policy_results_total" in server.registry.expose()
+        finally:
+            batcher.stop()
+
+    def test_violating_pod_blocked_with_oracle_message(self):
+        server, batcher = self.make_server()
+        try:
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:latest")))
+            assert out["response"]["allowed"] is False
+            # faithful message comes from the oracle lane
+            assert "latest tag not allowed" in (
+                out["response"]["status"]["message"])
+        finally:
+            batcher.stop()

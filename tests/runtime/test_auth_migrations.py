@@ -1,0 +1,106 @@
+"""CanI self-subject-access-review (pkg/auth) and backward-compatibility
+migrations (pkg/backward_compatibility)."""
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.auth import Auth, can_i_generate
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.migrations import add_clone_labels, add_gr_labels
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.webhook import (
+    POLICY_VALIDATING_WEBHOOK_PATH,
+    WebhookServer,
+)
+
+GEN_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "gen-np"},
+    "spec": {"rules": [{
+        "name": "gen-np-r",
+        "match": {"resources": {"kinds": ["Namespace"]}},
+        "generate": {"apiVersion": "networking.k8s.io/v1",
+                     "kind": "NetworkPolicy", "name": "default-deny",
+                     "namespace": "{{request.object.metadata.name}}",
+                     "data": {"spec": {"podSelector": {}}}},
+    }]},
+}
+
+
+class TestCanI:
+    def test_allowed_by_default(self):
+        auth = Auth(FakeCluster())
+        assert auth.can_i_create("NetworkPolicy", "default")
+        assert auth.can_i_update("NetworkPolicy", "default")
+
+    def test_denied_verb(self):
+        cluster = FakeCluster()
+        cluster.deny_access.add(("create", "networkpolicies"))
+        auth = Auth(cluster)
+        assert not auth.can_i_create("NetworkPolicy", "default")
+        assert auth.can_i_update("NetworkPolicy", "default")
+
+    def test_can_i_generate_reports_missing_permission(self):
+        cluster = FakeCluster()
+        cluster.deny_access.add(("create", "networkpolicies"))
+        errors = can_i_generate(load_policy(GEN_POLICY), cluster)
+        assert errors and "create" in errors[0]
+
+    def test_policy_webhook_rejects_unexecutable_generate(self):
+        cluster = FakeCluster()
+        cluster.deny_access.add(("create", "networkpolicies"))
+        server = WebhookServer(policy_cache=PolicyCache(), client=cluster)
+        out = server.handle(POLICY_VALIDATING_WEBHOOK_PATH, {
+            "request": {"uid": "u", "kind": {"kind": "ClusterPolicy"},
+                        "operation": "CREATE", "object": GEN_POLICY}})
+        assert out["response"]["allowed"] is False
+        assert "permission" in out["response"]["status"]["message"]
+
+    def test_policy_webhook_accepts_executable_generate(self):
+        server = WebhookServer(policy_cache=PolicyCache(),
+                               client=FakeCluster())
+        out = server.handle(POLICY_VALIDATING_WEBHOOK_PATH, {
+            "request": {"uid": "u", "kind": {"kind": "ClusterPolicy"},
+                        "operation": "CREATE", "object": GEN_POLICY}})
+        assert out["response"]["allowed"] is True
+
+
+class TestMigrations:
+    def test_gr_labels_added(self):
+        cluster = FakeCluster([{
+            "apiVersion": "kyverno.io/v1", "kind": "GenerateRequest",
+            "metadata": {"name": "gr-1", "namespace": "kyverno"},
+            "spec": {"policy": "gen-np",
+                     "resource": {"kind": "Namespace", "name": "team-a",
+                                  "namespace": ""}},
+        }])
+        assert add_gr_labels(cluster) == 1
+        gr = cluster.get_resource("kyverno.io/v1", "GenerateRequest",
+                                  "kyverno", "gr-1")
+        labels = gr["metadata"]["labels"]
+        assert labels["generate.kyverno.io/policy-name"] == "gen-np"
+        assert labels["generate.kyverno.io/resource-kind"] == "Namespace"
+        # second run is a no-op
+        assert add_gr_labels(cluster) == 0
+
+    def test_clone_source_labeled(self):
+        clone_policy = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "clone-secret"},
+            "spec": {"rules": [{
+                "name": "clone-r",
+                "match": {"resources": {"kinds": ["Namespace"]}},
+                "generate": {"apiVersion": "v1", "kind": "Secret",
+                             "name": "regcred", "namespace": "{{x}}",
+                             "clone": {"namespace": "default",
+                                       "name": "regcred"}},
+            }]},
+        }
+        cluster = FakeCluster([
+            clone_policy,
+            {"apiVersion": "v1", "kind": "Secret",
+             "metadata": {"name": "regcred", "namespace": "default"}},
+        ])
+        assert add_clone_labels(cluster) == 1
+        src = cluster.get_resource("v1", "Secret", "default", "regcred")
+        assert (src["metadata"]["labels"]
+                ["generate.kyverno.io/clone-policy-name"] == "clone-secret")
+        assert add_clone_labels(cluster) == 0
