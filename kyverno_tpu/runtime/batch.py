@@ -81,7 +81,7 @@ class AdmissionBatcher:
                 return ATTENTION, []
             key = (int(ptype), kind, namespace, id(cps))
             bucket = self._buckets.get(key)
-            if bucket is None or bucket.cps is not cps:
+            if bucket is None:
                 bucket = self._buckets[key] = _Bucket(cps)
             bucket.items.append((resource, fut))
             self._lock.notify()
@@ -119,27 +119,29 @@ class AdmissionBatcher:
                 self._flush(cps, items)
 
     def _flush(self, cps, items) -> None:
+        # everything — including the verdict scatter — must resolve every
+        # future: an escaped exception would kill the worker thread and
+        # leave all subsequent admissions blocking on their timeout
         try:
             resources = [r for r, _ in items]
             batch = cps.flatten(resources)
             verdicts = np.asarray(cps.evaluate_device(batch))
+            for b, (_, fut) in enumerate(items):
+                row = []
+                clean = True
+                for ref in cps.rule_refs:
+                    v = Verdict(verdicts[b, ref.rule_index])
+                    if v is Verdict.NOT_APPLICABLE:
+                        continue
+                    row.append((ref.policy.name, ref.rule.name, v))
+                    if v not in (Verdict.PASS, Verdict.SKIP):
+                        clean = False
+                if not fut.done():
+                    fut.set_result((CLEAN if clean else ATTENTION, row))
         except Exception:
             for _, fut in items:
                 if not fut.done():
                     fut.set_result((ATTENTION, []))
-            return
-        for b, (_, fut) in enumerate(items):
-            row = []
-            clean = True
-            for ref in cps.rule_refs:
-                v = Verdict(verdicts[b, ref.rule_index])
-                if v is Verdict.NOT_APPLICABLE:
-                    continue
-                row.append((ref.policy.name, ref.rule.name, v))
-                if v not in (Verdict.PASS, Verdict.SKIP):
-                    clean = False
-            if not fut.done():
-                fut.set_result((CLEAN if clean else ATTENTION, row))
 
     def stop(self) -> None:
         with self._lock:

@@ -19,7 +19,8 @@ def add_gr_labels(client, namespace: str = "kyverno") -> int:
     """AddLabels (add_labels.go:20): label every existing GenerateRequest
     with its policy/resource coordinates. Returns the number updated."""
     updated = 0
-    for gr in client.list_resource("kyverno.io/v1", "GenerateRequest"):
+    for gr in client.list_resource("kyverno.io/v1", "GenerateRequest",
+                                   namespace):
         spec = gr.get("spec") or {}
         resource = spec.get("resource") or {}
         meta = gr.setdefault("metadata", {})

@@ -1,0 +1,51 @@
+"""Profiling hooks: the TPU-native replacement for the reference's pprof.
+
+The reference serves net/http/pprof on :6060 behind ``--profile``
+(cmd/kyverno/main.go:119-128). Here the equivalent is the JAX profiler's
+gRPC trace server (consumed by TensorBoard/xprof) plus an on-demand
+programmatic trace capture — device timelines instead of goroutine
+profiles, since the hot loop lives on the accelerator. Per-rule wall
+times remain embedded in engine responses (RuleStats.ProcessingTime
+parity), which covers the host-side view.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_server_started = False
+
+
+def maybe_start_profiler(port: int | None = None) -> bool:
+    """Start the JAX profiler server when requested. ``port`` defaults to
+    the KTPU_PROFILE_PORT env var; unset/0 disables — the --profile-gated
+    behavior of the reference."""
+    global _server_started
+    if _server_started:
+        return True
+    if port is None:
+        try:
+            port = int(os.environ.get("KTPU_PROFILE_PORT", "0"))
+        except ValueError:
+            port = 0
+    if not port:
+        return False
+    import jax
+
+    jax.profiler.start_server(port)
+    _server_started = True
+    return True
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture one trace window to ``log_dir`` (xprof/TensorBoard format):
+    the programmatic twin of hitting the pprof endpoint."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

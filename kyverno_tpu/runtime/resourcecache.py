@@ -70,23 +70,31 @@ class ResourceCache:
                     self._watching or now - entry.stamp < self.resync_s):
                 return entry.resource
             # reserve the key BEFORE fetching so a watch event arriving
-            # while the GET is in flight is captured (and wins below)
-            pending = _Entry(None, now, pending=True)
-            self._entries[key] = pending
+            # while the GET is in flight is captured (and wins below);
+            # concurrent readers share the first reader's reservation
+            # instead of overwriting it
+            pending = None
+            if entry is None or not entry.pending:
+                pending = _Entry(None, now, pending=True)
+                self._entries[key] = pending
         if self.client is None:
             with self._lock:
-                if self._entries.get(key) is pending:
+                if pending is not None and self._entries.get(key) is pending:
                     del self._entries[key]
             return None
         self.fetches += 1
         resource = self.client.get_resource(api_version, kind, namespace, name)
         with self._lock:
             current = self._entries.get(key)
-            if current is pending:
+            if pending is not None and current is pending:
                 self._entries[key] = _Entry(resource, now)
                 return resource
-            # a watch event replaced the reservation: it is fresher
-            return current.resource if current is not None else resource
+            if current is not None and not current.pending:
+                # a watch event landed during the GET: it is fresher
+                return current.resource
+            # another reader still owns the reservation; our fetched copy
+            # is the answer for THIS call either way
+            return resource
 
     def get_namespace_labels(self, namespace: str) -> dict:
         ns = self.get("v1", "Namespace", "", namespace)

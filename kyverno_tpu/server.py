@@ -20,7 +20,7 @@ import time
 
 from .api.load import load_policy
 from .policy.autogen import mutate_policy_for_autogen
-from .runtime import migrations
+from .runtime import migrations, profiling
 from .runtime.background import BackgroundScanner
 from .runtime.batch import AdmissionBatcher
 from .runtime.client import Client, FakeCluster, RestClient, RestConfig
@@ -186,6 +186,7 @@ class Controller:
     # ------------------------------------------------------------ lifecycle
 
     def start(self, host: str = "0.0.0.0") -> None:
+        profiling.maybe_start_profiler()  # KTPU_PROFILE_PORT-gated
         if self.cert_renewer is not None:
             self.cert_renewer.generate()
         self.sync_config()
@@ -246,8 +247,15 @@ class Controller:
         self.elector.stop()
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
     client = RestClient(RestConfig.in_cluster())
+    if "--init-only" in argv:
+        # the init-container entrypoint (cmd/initContainer/main.go)
+        init_cleanup(client)
+        return 0
     controller = Controller(client=client, enable_tls=True)
     init_cleanup(client)
     controller.start()

@@ -1,0 +1,124 @@
+"""End-to-end: the assembled Controller over real HTTP, and deploy-manifest
+sanity (the L9 tier of SURVEY.md section 4 — e2e without a kind cluster:
+FakeCluster is the API server, the HTTP surface is real)."""
+
+import json
+import pathlib
+import urllib.request
+
+import yaml
+
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.webhookconfig import VALIDATING_WEBHOOK_CONFIG
+from kyverno_tpu.server import Controller
+
+ENFORCE_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "background": True,
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }],
+    },
+}
+
+
+def review(resource):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u1", "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+
+
+def pod(image):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": image}]}}
+
+
+class TestControllerE2E:
+    def test_full_lifecycle(self):
+        cluster = FakeCluster([ENFORCE_POLICY, pod("nginx:latest")])
+        controller = Controller(client=cluster, serve_port=0)
+        controller.start(host="127.0.0.1")
+        try:
+            port = controller._httpd.server_address[1]
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    return json.loads(resp.read())
+
+            # enforce blocks over the wire
+            out = post("/validate", review(pod("nginx:latest")))
+            assert out["response"]["allowed"] is False
+            out = post("/validate", review(pod("nginx:1.21")))
+            assert out["response"]["allowed"] is True
+
+            # leader tasks registered the webhooks (leader = only replica)
+            controller.elector.try_acquire_or_renew()
+            controller._start_leader_tasks()
+            assert cluster.get_resource(
+                "admissionregistration.k8s.io/v1",
+                "ValidatingWebhookConfiguration", "",
+                VALIDATING_WEBHOOK_CONFIG) is not None
+
+            # background scan over the stored snapshot reports a violation
+            result = controller.run_background_scan()
+            assert result.violations >= 1
+            reports = cluster.list_resource(
+                "wgpolicyk8s.io/v1alpha2", "PolicyReport")
+            assert reports and any(
+                r["summary"]["fail"] >= 1 for r in reports)
+        finally:
+            controller.stop()
+
+
+class TestDeployManifests:
+    MANIFEST_DIR = pathlib.Path(__file__).resolve().parents[2] / "deploy"
+
+    def _docs(self, name):
+        with open(self.MANIFEST_DIR / name) as f:
+            return [d for d in yaml.safe_load_all(f) if d]
+
+    def test_crds_parse_and_cover_api_types(self):
+        docs = self._docs("crds.yaml")
+        kinds = {d["spec"]["names"]["kind"] for d in docs}
+        assert kinds >= {"ClusterPolicy", "Policy", "GenerateRequest",
+                         "PolicyReport", "ClusterPolicyReport",
+                         "ReportChangeRequest"}
+        for d in docs:
+            assert d["kind"] == "CustomResourceDefinition"
+            assert d["spec"]["versions"][0]["schema"]
+
+    def test_install_wires_the_controller(self):
+        docs = self._docs("install.yaml")
+        by_kind = {}
+        for d in docs:
+            by_kind.setdefault(d["kind"], []).append(d)
+        assert set(by_kind) >= {"Namespace", "ServiceAccount", "ClusterRole",
+                                "ClusterRoleBinding", "ConfigMap", "Service",
+                                "Deployment"}
+        [dep] = by_kind["Deployment"]
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["initContainers"][0]["command"][-1] == "--init-only"
+        [ctr] = spec["containers"]
+        ports = {p["name"]: p["containerPort"] for p in ctr["ports"]}
+        assert ports == {"https": 9443, "metrics": 8000}
+        # the webhook Service must target the serving port
+        svc = next(s for s in by_kind["Service"]
+                   if s["metadata"]["name"] == "kyverno-svc")
+        assert svc["spec"]["ports"][0]["targetPort"] == 9443
+        # SelfSubjectAccessReview permission present for CanI checks
+        [role] = by_kind["ClusterRole"]
+        assert any("selfsubjectaccessreviews" in r.get("resources", [])
+                   for r in role["rules"])
