@@ -8,7 +8,7 @@ per-rule rollback, queried through the JMESPath dialect.
 
 from __future__ import annotations
 
-import copy
+from ..utils.jsoncopy import json_copy
 import json
 from dataclasses import asdict
 
@@ -24,7 +24,7 @@ def merge_patch(target, patch):
     """RFC7386 JSON merge-patch: dict keys merge recursively, null deletes,
     everything else replaces."""
     if not isinstance(patch, dict):
-        return copy.deepcopy(patch)
+        return json_copy(patch)
     if not isinstance(target, dict):
         target = {}
     else:
@@ -60,10 +60,10 @@ class Context:
 
     def add_resource(self, resource: dict) -> None:
         """Resource at ``request.object`` (context.go:116)."""
-        self.add_json({"request": {"object": copy.deepcopy(resource)}})
+        self.add_json({"request": {"object": json_copy(resource)}})
 
     def add_old_resource(self, resource: dict) -> None:
-        self.add_json({"request": {"oldObject": copy.deepcopy(resource)}})
+        self.add_json({"request": {"oldObject": json_copy(resource)}})
 
     def add_user_info(self, request_info) -> None:
         """RequestInfo at ``request.{roles,clusterRoles,userInfo}``."""
@@ -98,7 +98,7 @@ class Context:
 
     def add_element(self, element, index: int) -> None:
         """foreach iteration variable: element / elementIndex."""
-        self.add_json({"element": copy.deepcopy(element), "elementIndex": index})
+        self.add_json({"element": json_copy(element), "elementIndex": index})
 
     def add_image_info(self, resource: dict) -> None:
         images = extract_image_info(resource)
@@ -133,12 +133,12 @@ class Context:
         return obj != old
 
     def snapshot(self) -> dict:
-        return copy.deepcopy(self._data)
+        return json_copy(self._data)
 
     # -------------------------------------------------------- checkpoints
 
     def checkpoint(self) -> None:
-        self._checkpoints.append(copy.deepcopy(self._data))
+        self._checkpoints.append(json_copy(self._data))
 
     def restore(self) -> None:
         """Pop to the last checkpoint (context.go:322)."""
@@ -148,7 +148,7 @@ class Context:
     def reset(self) -> None:
         """Return to the last checkpoint, keeping it (context.go:327)."""
         if self._checkpoints:
-            self._data = copy.deepcopy(self._checkpoints[-1])
+            self._data = json_copy(self._checkpoints[-1])
 
 
 # ----------------------------------------------------------- image parsing
@@ -246,7 +246,7 @@ def mutate_resource_with_image_info(resource: dict, ctx: Context) -> tuple[dict,
     if ctx.images is None:
         return resource, []
     patches = []
-    patched = copy.deepcopy(resource)
+    patched = json_copy(resource)
     for bucket in ("containers", "initContainers"):
         for info in (ctx.images.get(bucket) or {}).values():
             pointer = info.get("jsonPath", "")

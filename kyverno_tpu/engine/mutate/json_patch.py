@@ -14,7 +14,7 @@ from-scratch implementation of the pieces the engine needs:
 
 from __future__ import annotations
 
-import copy
+from ...utils.jsoncopy import json_copy
 import re
 from fnmatch import fnmatchcase
 
@@ -107,7 +107,7 @@ def apply_patch_ops(doc, ops: list[dict]):
     """Apply an RFC6902 op list to a deep copy of ``doc``; returns the new
     document. Options match the reference (patchJson6902.go:76). Malformed
     ops surface as JsonPatchError (a failed rule), never as a crash."""
-    result = copy.deepcopy(doc)
+    result = json_copy(doc)
     for op in ops:
         try:
             result = _apply_one(result, op)
@@ -131,15 +131,15 @@ def _apply_one(doc, op: dict):
         return doc
     if operation == "add":
         if not tokens:
-            return copy.deepcopy(op.get("value"))
+            return json_copy(op.get("value"))
         parent = _resolve_parent(doc, tokens, ensure=True)
-        _add(parent, tokens[-1], copy.deepcopy(op.get("value")))
+        _add(parent, tokens[-1], json_copy(op.get("value")))
         return doc
     if operation == "replace":
         if not tokens:
-            return copy.deepcopy(op.get("value"))
+            return json_copy(op.get("value"))
         parent = _resolve_parent(doc, tokens)
-        _replace(parent, tokens[-1], copy.deepcopy(op.get("value")))
+        _replace(parent, tokens[-1], json_copy(op.get("value")))
         return doc
     if operation == "remove":
         try:
@@ -156,7 +156,7 @@ def _apply_one(doc, op: dict):
         _add(parent, tokens[-1], value)
         return doc
     if operation == "copy":
-        value = copy.deepcopy(get_by_pointer(doc, op["from"]))
+        value = json_copy(get_by_pointer(doc, op["from"]))
         parent = _resolve_parent(doc, tokens, ensure=True)
         _add(parent, tokens[-1], value)
         return doc

@@ -11,7 +11,7 @@ YAML object model onto the hot path.
 
 from __future__ import annotations
 
-import copy
+from ...utils.jsoncopy import json_copy
 
 from ..anchors import (
     is_addition_anchor,
@@ -48,7 +48,7 @@ def pre_process_pattern(pattern, resource):
     """strategicPreprocessing.go:47 preProcessPattern. Returns the
     anchor-resolved patch (a new tree); raises ConditionError /
     GlobalConditionError when the rule must be skipped."""
-    pattern = copy.deepcopy(pattern)
+    pattern = json_copy(pattern)
     _pre_process_recursive(pattern, resource)
     if isinstance(pattern, dict):
         _delete_condition_elements(pattern)
@@ -100,7 +100,7 @@ def _process_list_of_maps(pattern: list, resource) -> None:
         last_global_error: GlobalConditionError | None = None
 
         for resource_element in resource_elements:
-            candidate = copy.deepcopy(pattern_element)
+            candidate = json_copy(pattern_element)
             try:
                 _pre_process_recursive(candidate, resource_element)
             except ConditionError:
@@ -120,7 +120,7 @@ def _process_list_of_maps(pattern: list, resource) -> None:
             if not name:
                 continue
 
-            new_node = copy.deepcopy(candidate)
+            new_node = json_copy(candidate)
             if _delete_conditions_from_nested_maps(new_node):
                 continue  # nothing left to patch
             new_node["name"] = name
@@ -252,22 +252,22 @@ def merge(patch, base):
             elif key in out:
                 out[key] = merge(value, out[key])
             else:
-                out[key] = copy.deepcopy(value)
+                out[key] = json_copy(value)
         return out
     if isinstance(patch, list) and isinstance(base, list):
         if patch and base:
             key = _find_merge_key(patch)
             if key is not None and all(isinstance(e, dict) and key in e for e in base):
-                out = [copy.deepcopy(e) for e in base]
+                out = [json_copy(e) for e in base]
                 index = {e[key]: i for i, e in enumerate(out)}
                 for el in patch:
                     if el[key] in index:
                         out[index[el[key]]] = merge(el, out[index[el[key]]])
                     else:
-                        out.append(copy.deepcopy(el))
+                        out.append(json_copy(el))
                 return out
-        return copy.deepcopy(patch)
-    return copy.deepcopy(patch)
+        return json_copy(patch)
+    return json_copy(patch)
 
 
 def strategic_merge_patch(base: dict, overlay):
@@ -277,5 +277,5 @@ def strategic_merge_patch(base: dict, overlay):
     try:
         patch = pre_process_pattern(overlay, base)
     except (ConditionError, GlobalConditionError):
-        return copy.deepcopy(base)
+        return json_copy(base)
     return merge(patch, base)

@@ -1,0 +1,215 @@
+"""Scenario runner: replay the reference's pkg/testrunner corpus.
+
+SURVEY.md section 4 tier 3: YAML scenarios under
+/root/reference/test/scenarios declare input.policy/input.resource and the
+expected PolicyResponse for mutation, validation and generation; the
+reference executes them in pkg/testrunner/scenario.go:132 runTestCase
+(Mutate -> patched-resource golden compare -> Validate -> Generate with a
+mock client for Namespace resources). This runner mirrors that flow and
+comparison (compareRules: name, type, status, and message when the
+expectation carries one) over the exact scenario list of
+pkg/testrunner/testrunner_test.go.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.generation import generate as engine_generate
+from kyverno_tpu.engine.mutation import mutate as engine_mutate
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.validation import validate as engine_validate
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.generate_controller import apply_generate_rule
+
+REFERENCE_ROOT = "/root/reference"
+
+# pkg/testrunner/testrunner_test.go:6-87 (the commented-out add_ns_quota
+# scenario is excluded there too)
+SCENARIOS = [
+    "test/scenarios/other/scenario_mutate_endpoint.yaml",
+    "test/scenarios/other/scenario_mutate_validate_qos.yaml",
+    "test/scenarios/samples/best_practices/disallow_priviledged.yaml",
+    "test/scenarios/other/scenario_validate_healthChecks.yaml",
+    "test/scenarios/samples/best_practices/disallow_host_network_port.yaml",
+    "test/scenarios/samples/best_practices/disallow_host_pid_ipc.yaml",
+    "test/scenarios/other/scenario_validate_disallow_default_serviceaccount.yaml",
+    "test/scenarios/other/scenario_validate_selinux_context.yaml",
+    "test/scenarios/other/scenario_validate_default_proc_mount.yaml",
+    "test/scenarios/other/scenario_validate_volume_whiltelist.yaml",
+    "test/scenarios/samples/best_practices/disallow_bind_mounts_fail.yaml",
+    "test/scenarios/samples/best_practices/disallow_bind_mounts_pass.yaml",
+    "test/scenarios/samples/best_practices/disallow_sysctls.yaml",
+    "test/scenarios/samples/best_practices/add_safe_to_evict.yaml",
+    "test/scenarios/samples/best_practices/add_safe_to_evict2.yaml",
+    "test/scenarios/samples/best_practices/add_safe_to_evict3.yaml",
+    "test/scenarios/samples/more/restrict_automount_sa_token.yaml",
+    "test/scenarios/samples/more/restrict_ingress_classes.yaml",
+    "test/scenarios/samples/more/unknown_ingress_class.yaml",
+    "test/scenarios/other/scenario_mutate_pod_spec.yaml",
+]
+
+
+def _ref_path(rel: str) -> str:
+    return os.path.join(REFERENCE_ROOT, rel.lstrip("/"))
+
+
+def _load_yaml(rel: str):
+    with open(_ref_path(rel)) as f:
+        return yaml.safe_load(f)
+
+
+def _strip_go_zero_fields(doc):
+    """Normalize Go typed-marshaling artifacts out of golden comparisons:
+    the reference's strategic merge round-trips resources through typed
+    structs, so zero-valued fields surface as ``null`` / ``{}`` in the
+    golden files (metadata.creationTimestamp: null, spec.strategy: {},
+    status: {}). The untyped engine here never invents such keys; both
+    sides drop them before comparing."""
+    if isinstance(doc, dict):
+        # strip bottom-up so containers that only become empty after
+        # stripping (e.g. metadata: {creationTimestamp: null}) drop too
+        out = {}
+        for k, v in doc.items():
+            stripped = _strip_go_zero_fields(v)
+            if stripped is not None and stripped != {}:
+                out[k] = stripped
+        return out
+    if isinstance(doc, list):
+        return [_strip_go_zero_fields(v) for v in doc]
+    return doc
+
+
+def _compare_response(policy_response, expected: dict, where: str) -> list[str]:
+    """scenario.go:246 validateResponse + compareRules."""
+    errors: list[str] = []
+    if not expected:
+        return errors
+    exp_policy = expected.get("policy") or {}
+    if exp_policy.get("name") and policy_response.policy.name != exp_policy["name"]:
+        errors.append(f"{where}: policy name {policy_response.policy.name!r}"
+                      f" != {exp_policy['name']!r}")
+    exp_res = expected.get("resource") or {}
+    for field, attr in (("kind", "kind"), ("namespace", "namespace"),
+                        ("name", "name")):
+        want = exp_res.get(field)
+        got = getattr(policy_response.resource, attr)
+        if want is not None and got != want:
+            errors.append(f"{where}: resource {field} {got!r} != {want!r}")
+    exp_rules = expected.get("rules") or []
+    got_rules = policy_response.rules
+    if len(got_rules) != len(exp_rules):
+        errors.append(
+            f"{where}: rule count {len(got_rules)} != {len(exp_rules)} "
+            f"(got {[r.name for r in got_rules]})")
+        return errors
+    for got, want in zip(got_rules, exp_rules):
+        if got.name != want.get("name"):
+            errors.append(f"{where}: rule name {got.name!r} != "
+                          f"{want.get('name')!r}")
+            continue
+        if want.get("type") and got.type.value != want["type"]:
+            errors.append(f"{where}/{got.name}: type {got.type.value!r} != "
+                          f"{want['type']!r}")
+        if want.get("status") and got.status.value != want["status"]:
+            errors.append(f"{where}/{got.name}: status {got.status.value!r}"
+                          f" != {want['status']!r} ({got.message})")
+        if want.get("message") and got.message != want["message"]:
+            errors.append(f"{where}/{got.name}: message {got.message!r} != "
+                          f"{want['message']!r}")
+    return errors
+
+
+def run_test_case(tc: dict) -> list[str]:
+    """scenario.go:132 runTestCase."""
+    errors: list[str] = []
+    policy = load_policy(_load_yaml(tc["input"]["policy"]))
+    resource = _load_yaml(tc["input"]["resource"])
+    expected = tc.get("expected") or {}
+
+    # ---- mutation
+    jctx = Context()
+    jctx.add_resource(resource)
+    mresp = engine_mutate(PolicyContext(
+        policy=policy, new_resource=resource, json_context=jctx))
+    mutation = expected.get("mutation") or {}
+    golden = mutation.get("patchedresource", "")
+    if golden:
+        want = _load_yaml(golden)
+        if _strip_go_zero_fields(mresp.patched_resource) != \
+                _strip_go_zero_fields(want):
+            errors.append("mutation: patched resource != golden "
+                          f"{golden}")
+    errors += _compare_response(mresp.policy_response,
+                                mutation.get("policyresponse") or {},
+                                "mutation")
+    if mresp.policy_response.rules:
+        resource = mresp.patched_resource
+
+    # ---- validation
+    jctx = Context()
+    jctx.add_resource(resource)
+    vresp = engine_validate(PolicyContext(
+        policy=policy, new_resource=resource, json_context=jctx))
+    errors += _compare_response(vresp.policy_response,
+                                (expected.get("validation") or {})
+                                .get("policyresponse") or {},
+                                "validation")
+
+    # ---- generation (Namespace triggers, scenario.go:173)
+    generation = expected.get("generation") or {}
+    if resource.get("kind") == "Namespace" and generation:
+        client = FakeCluster()
+        for rel in tc["input"].get("loadresources") or []:
+            client.create_resource(_load_yaml(rel))
+        client.create_resource(resource)
+        jctx = Context()
+        jctx.add_resource(resource)
+        pctx = PolicyContext(policy=policy, new_resource=resource,
+                             client=client, json_context=jctx)
+        gresp = engine_generate(pctx)
+        errors += _compare_response(gresp.policy_response,
+                                    generation.get("policyresponse") or {},
+                                    "generation")
+        # materialize like the generate controller, then check existence
+        for rule in policy.spec.rules:
+            if rule.has_generate():
+                try:
+                    apply_generate_rule(rule, pctx, resource, client)
+                except Exception as e:
+                    errors.append(f"generation: apply failed: {e}")
+        ns = (resource.get("metadata") or {}).get("name", "")
+        for spec in generation.get("generatedResources") or []:
+            if client.get_resource("", spec.get("kind", ""),
+                                   spec.get("namespace") or ns,
+                                   spec.get("name", "")) is None:
+                errors.append(
+                    f"generation: {spec.get('kind')}/{spec.get('name')} "
+                    f"not generated")
+    return errors
+
+
+# The selinux scenario's expectation is stale relative to the reference
+# ENGINE at this snapshot: it expects pattern level: "*" to fail against
+# level: "", but the reference's own unit test asserts the opposite —
+# validateString("", "*", Equal) is true (pattern_test.go:19
+# TestValidateString_AsteriskTest). This engine matches the reference
+# engine, so the scenario is expected to fail its stale golden.
+_STALE = {"test/scenarios/other/scenario_validate_selinux_context.yaml"}
+
+
+@pytest.mark.parametrize(
+    "scenario",
+    [pytest.param(s, marks=pytest.mark.xfail(
+        reason="stale golden vs pattern_test.go:19", strict=True))
+     if s in _STALE else s for s in SCENARIOS],
+    ids=lambda s: os.path.basename(str(s)).rsplit(".", 1)[0])
+def test_reference_scenario(scenario):
+    doc = _load_yaml(scenario)
+    all_errors: list[str] = []
+    for tc in doc.get("testcases") or [doc]:
+        all_errors += run_test_case(tc)
+    assert not all_errors, "\n".join(all_errors)
