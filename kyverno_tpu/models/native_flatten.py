@@ -32,43 +32,51 @@ _SO = _REPO_ROOT / "native" / "build" / "libktpu_flatten.so"
 
 _lib = None
 _lib_failed = False
+_lib_lock = __import__("threading").Lock()
 
 
 def _load_lib():
     global _lib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
-    try:
-        if not _SO.exists() or _SO.stat().st_mtime < _CPP.stat().st_mtime:
-            _SO.parent.mkdir(parents=True, exist_ok=True)
-            subprocess.run(
-                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                 str(_CPP), "-o", str(_SO)],
-                check=True, capture_output=True, timeout=120,
-            )
-        lib = ctypes.CDLL(str(_SO))
-    except (OSError, subprocess.SubprocessError):
-        _lib_failed = True
-        return None
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _CPP.stat().st_mtime:
+                _SO.parent.mkdir(parents=True, exist_ok=True)
+                # build to a temp name, then atomic rename: a concurrent
+                # process must never CDLL a half-written .so
+                tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     str(_CPP), "-o", str(tmp)],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(str(_SO))
+        except (OSError, subprocess.SubprocessError):
+            _lib_failed = True
+            return None
 
-    lib.ktpu_create.restype = ctypes.c_void_p
-    lib.ktpu_create.argtypes = [
-        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
-        ctypes.c_char_p, ctypes.c_char_p,
-    ]
-    lib.ktpu_destroy.argtypes = [ctypes.c_void_p]
-    lib.ktpu_flatten_batch.restype = ctypes.c_int
-    lib.ktpu_flatten_batch.argtypes = [
-        ctypes.c_void_p,
-        ctypes.c_char_p, ctypes.c_int64,       # docs
-        ctypes.c_char_p, ctypes.c_int64,       # reqs (nullable)
-        ctypes.c_int, ctypes.c_int,            # n_docs, max_slots
-        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),  # e_cap, e_needed
-    ] + [ctypes.c_void_p] * 19 + [
-        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
-    ]
-    _lib = lib
-    return lib
+        lib.ktpu_create.restype = ctypes.c_void_p
+        lib.ktpu_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.ktpu_destroy.argtypes = [ctypes.c_void_p]
+        lib.ktpu_flatten_batch.restype = ctypes.c_int
+        lib.ktpu_flatten_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_int64,       # docs
+            ctypes.c_char_p, ctypes.c_int64,       # reqs (nullable)
+            ctypes.c_int, ctypes.c_int,            # n_docs, max_slots
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32),  # e_cap, e_needed
+        ] + [ctypes.c_void_p] * 19 + [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
+        ]
+        _lib = lib
+        return lib
 
 
 def native_available() -> bool:

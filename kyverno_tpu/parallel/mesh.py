@@ -74,24 +74,53 @@ def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
     )
 
 
+DEFAULT_CHUNK = 65_536  # scan chunk size: bounds flatten + device memory
+
+
 def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
-                 axis: str = "data"):
+                 axis: str = "data", chunk_size: int = DEFAULT_CHUNK,
+                 flatten_workers: int = 6):
     """Background-scan entry: flatten, pad to the mesh, evaluate sharded.
 
     Returns (verdicts [B, R] numpy, fails [R], passes [R]) — the mesh-scale
     replay of /root/reference/pkg/policy/existing.go:20
-    processExistingResources. Host-lane cells (Verdict.HOST) are resolved
-    through the CPU oracle exactly like CompiledPolicySet.evaluate, and the
-    pass/fail counts are recomputed over the resolved matrix so
-    precondition/context rules are reported, not dropped.
+    processExistingResources. The per-rule counts come from the on-device
+    psum of sharded_eval_fn; when host-lane cells (Verdict.HOST) are
+    present they are resolved through the CPU oracle exactly like
+    CompiledPolicySet.evaluate and the counts recomputed over the resolved
+    matrix, so precondition/context rules are reported, not dropped.
+
+    Snapshots larger than ``chunk_size`` stream through a pipeline of
+    ``flatten_workers`` threads, each flattening its chunk (the native
+    flattener releases the GIL), dispatching to the mesh, and blocking on
+    its own result — so at most ``flatten_workers`` chunks are in flight
+    on device at once (the memory bound chunking exists for) while
+    transfers and evals still overlap across workers.
     """
-    batch = cps.flatten(resources)
-    batch, n = pad_batch(batch, mesh.devices.size)
     fn = sharded_eval_fn(cps, mesh, axis)
-    verdict, fails, passes = fn(*batch.device_args())
-    verdicts = np.array(verdict)[:n]
+
+    def eval_chunk(chunk: list[dict]):
+        batch, n = pad_batch(cps.flatten(chunk), mesh.devices.size)
+        verdict, fails, passes = fn(*batch.device_args())
+        # materialize here: backpressure — the worker owns its chunk until
+        # the device is done with it
+        return np.array(verdict)[:n], np.array(fails), np.array(passes)
+
+    if len(resources) <= chunk_size:
+        verdicts, fails, passes = eval_chunk(resources)
+    else:
+        import concurrent.futures
+
+        chunks = [resources[i:i + chunk_size]
+                  for i in range(0, len(resources), chunk_size)]
+        with concurrent.futures.ThreadPoolExecutor(flatten_workers) as ex:
+            outs = list(ex.map(eval_chunk, chunks))
+        verdicts = np.concatenate([v for v, _, _ in outs])
+        fails = np.sum([f for _, f, _ in outs], axis=0)
+        passes = np.sum([p for _, _, p in outs], axis=0)
+
     if (verdicts == V_HOST).any():
         verdicts = cps.resolve_host_cells(resources, verdicts)
         fails = (verdicts == V_FAIL).sum(axis=0)
         passes = (verdicts == V_PASS).sum(axis=0)
-    return verdicts, np.array(fails), np.array(passes)
+    return verdicts, np.asarray(fails), np.asarray(passes)

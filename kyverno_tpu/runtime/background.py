@@ -107,7 +107,17 @@ class BackgroundScanner:
 
             verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
         else:
-            verdicts = self.cps.evaluate(resources)
+            from ..parallel.mesh import DEFAULT_CHUNK
+
+            if len(resources) > DEFAULT_CHUNK:
+                # chunk huge snapshots so flatten memory stays bounded
+                import numpy as _np
+
+                verdicts = _np.concatenate([
+                    self.cps.evaluate(resources[i:i + DEFAULT_CHUNK])
+                    for i in range(0, len(resources), DEFAULT_CHUNK)])
+            else:
+                verdicts = self.cps.evaluate(resources)
 
         for b, resource in enumerate(resources):
             meta = resource.get("metadata") or {}

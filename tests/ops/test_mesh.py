@@ -44,6 +44,18 @@ def test_sharded_scan_matches_single_device(cps):
     np.testing.assert_array_equal(fails, want_fails)
 
 
+def test_sharded_scan_chunked_pipeline(cps):
+    """Snapshots beyond chunk_size stream through the flatten/eval
+    pipeline; results must equal the unchunked scan."""
+    mesh = make_mesh()
+    resources = [make_pod(i) for i in range(50)]
+    chunked, cf, cp_ = sharded_scan(cps, resources, mesh, chunk_size=16)
+    whole, wf, wp = sharded_scan(cps, resources, mesh)
+    assert (chunked == whole).all()
+    np.testing.assert_array_equal(cf, wf)
+    np.testing.assert_array_equal(cp_, wp)
+
+
 def test_sharded_scan_resolves_host_lane():
     """A policy set containing host-only rules (variables in the pattern)
     must still produce their verdicts from a mesh scan — HOST cells resolve

@@ -1,0 +1,123 @@
+"""Fault-injection / stress tier (SURVEY.md section 4.6).
+
+The reference's LitmusChaos experiment (litmuschaos/pod_cpu_hog) hogs the
+controller's CPU and asserts the webhook still enforces. The in-process
+analogue: saturate every core with busy-loop threads while hammering the
+HTTP webhook with concurrent mixed admissions — every verdict must still
+be correct and the server must stay within the reference's 10s admission
+budget. Plus the monitor's self-healing path: webhook configs deleted out
+from under the controller are re-registered after the idle deadline."""
+
+import concurrent.futures
+import json
+import threading
+import time
+import urllib.request
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH, WebhookServer
+from kyverno_tpu.runtime.webhookconfig import (
+    VALIDATING_WEBHOOK_CONFIG,
+    Monitor,
+    Register,
+)
+
+ENFORCE = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }],
+    },
+}
+
+
+def review(i, image):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": f"u{i}", "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": {"apiVersion": "v1", "kind": "Pod",
+                                   "metadata": {"name": f"p{i}",
+                                                "namespace": "default"},
+                                   "spec": {"containers": [
+                                       {"name": "c", "image": image}]}}}}
+
+
+def test_webhook_enforces_under_cpu_hog():
+    cache = PolicyCache()
+    cache.add(load_policy(ENFORCE))
+    server = WebhookServer(policy_cache=cache, client=FakeCluster())
+    httpd = server.run(host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+
+    stop = threading.Event()
+
+    # GIL-sharing busy loops (a Python-thread CPU hog is harsher than the
+    # litmus OS-level hog: it contends for the same interpreter lock the
+    # handlers need); shrink the switch interval so the server still gets
+    # scheduled the way OS preemption would provide
+    import sys
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(0.0005)
+
+    def burn():
+        x = 0
+        while not stop.is_set():
+            x = (x * 31 + 7) % 1_000_003
+        return x
+
+    hogs = [threading.Thread(target=burn, daemon=True) for _ in range(4)]
+    for h in hogs:
+        h.start()
+
+    def admit(i):
+        image = "nginx:latest" if i % 3 == 0 else "nginx:1.21"
+        body = json.dumps(review(i, image)).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{VALIDATING_WEBHOOK_PATH}", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        return i, out["response"]["allowed"], time.monotonic() - t0
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            results = list(ex.map(admit, range(60)))
+    finally:
+        stop.set()
+        sys.setswitchinterval(old_interval)
+        server.stop()
+
+    lat = sorted(r[2] for r in results)
+    for i, allowed, _ in results:
+        assert allowed == (i % 3 != 0), f"wrong verdict under load for {i}"
+    # reference admission budget: 10s webhook timeout
+    assert lat[-1] < 10.0, f"p100 latency {lat[-1]:.1f}s exceeds the budget"
+
+
+def test_monitor_reregisters_deleted_webhooks():
+    """monitor.go:16-40: no admissions for 5 idle intervals -> the monitor
+    re-registers deleted webhook configurations."""
+    cluster = FakeCluster()
+    register = Register(cluster)
+    register.register()
+    assert register.check()
+
+    # a cluster admin deletes the configs out from under the controller
+    register.remove()
+    assert not register.check()
+
+    monitor = Monitor(register)
+    monitor.set_time(time.time() - 1000)  # far past the re-register deadline
+    monitor.check_once()
+    assert register.check(), "monitor did not self-heal the webhook configs"
