@@ -67,6 +67,7 @@ class PolicyTensors:
     chk_num_hi: np.ndarray                # [C] int64
     chk_bool: np.ndarray                  # [C] bool
     chk_num_fallback: np.ndarray          # [C] bool
+    chk_num_mode: np.ndarray              # [C] int8 (ir.CheckIR.num_mode)
     chk_track_depth: np.ndarray           # [C] int8 anchorMap key depth (-1)
     chk_cond_depth: np.ndarray            # [C] int8 condition key depth (-1)
 
@@ -230,7 +231,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
     chk_cols: dict[str, list] = {k: [] for k in (
         "path", "op", "rule", "alt", "group", "gate", "guard", "is_gate",
         "is_cond", "tracked", "exist", "nfa", "lo", "hi", "bool", "numfb",
-        "track_depth", "cond_depth",
+        "num_mode", "track_depth", "cond_depth",
     )}
     group_alt: list[int] = []
     alt_rule: list[int] = []
@@ -318,6 +319,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
                 local_chk["hi"].append(c.num_hi)
                 local_chk["bool"].append(c.bool_val)
                 local_chk["numfb"].append(c.num_fallback)
+                local_chk["num_mode"].append(c.num_mode)
                 local_chk["track_depth"].append(track_depth)
                 local_chk["cond_depth"].append(c.cond_depth)
 
@@ -456,6 +458,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         chk_num_hi=arr(chk_cols, "hi", np.int64),
         chk_bool=arr(chk_cols, "bool", bool),
         chk_num_fallback=arr(chk_cols, "numfb", bool),
+        chk_num_mode=arr(chk_cols, "num_mode", np.int8),
         chk_track_depth=arr(chk_cols, "track_depth", np.int8),
         chk_cond_depth=arr(chk_cols, "cond_depth", np.int8),
         n_groups=len(group_alt),

@@ -227,7 +227,9 @@ def _enumerate_slots(resource, segments: list[str], request: dict,
         bit = 1 << (i + 1 + offset)
         if seg == "*":
             if not isinstance(node, list):
-                out.append((mask, elem0, None, False, False))
+                # a list pattern over an existing non-list node is a
+                # structural mismatch (validateResourceElement array case)
+                out.append((mask, elem0, None, False, True))
                 return
             for idx, el in enumerate(node):
                 walk(el, i + 1, mask | bit, idx if elem0 < 0 else elem0)
@@ -337,6 +339,11 @@ def flatten_batch(resources: list[dict], tensors: PolicyTensors,
                         # >36-digit number part: exact range exceeded
                         host_flag[b] = True
                         continue
+                    try:
+                        int(value, 10)
+                        num_int[b, p, e] = True  # strconv.ParseInt-able
+                    except ValueError:
+                        pass
                     n = _value_to_micro(value)
                     if n is not None:
                         num_val[b, p, e] = n

@@ -64,6 +64,8 @@ class CheckOp(IntEnum):
     IS_NULL = 11
     EXISTS_OBJECT = 12  # pattern {} -> value must be a map
     ABSENT = 13         # negation anchor: path must not exist
+    EXISTS_NONNIL = 14  # DefaultHandler "*": key present and non-null
+                        # (anchor/anchor.go:118)
 
 
 class CheckAnchor(IntEnum):
@@ -187,9 +189,14 @@ class CheckIR:
     num_lo: int = 0                 # micro-units; for NUM_* (lo==hi for EQ)
     num_hi: int = 0
     bool_val: bool = False
-    # a string-op check whose operand parses as a quantity also accepts
-    # numeric resource values via numeric comparison (pattern.go:264)
+    # a string-op check whose operand has a number part (pattern.go:312)
+    # that parses as a quantity compares quantities on both sides
+    # (validateNumberWithStr, pattern.go:264); non-quantity values fail
     num_fallback: bool = False
+    # NUM_EQ literal semantics (pattern.go:67/95): 0 = quantity compare
+    # (string-op rows), 1 = int literal (strings need ParseInt),
+    # 2 = float literal (strings need ParseFloat)
+    num_mode: int = 0
     # OR-over-elements (existence anchor) instead of AND-over-elements
     existence: bool = False
     # equality-anchor guard bitmask: bit d set => if segment-prefix of depth
@@ -299,6 +306,13 @@ class _PatternCompiler:
                 self._walk_existence(value, child_path)
             elif kind is Anchor.ADD_IF_NOT_PRESENT:
                 raise HostOnly("+() anchor is mutate-only")
+            elif value == "*":
+                # DefaultHandler's special case (anchor/anchor.go:118):
+                # a plain map key with pattern "*" means "present and
+                # non-null" for ANY value type — maps and lists included,
+                # which the elementary string compare would reject
+                self._emit(CheckIR(path=child_path, op=CheckOp.EXISTS_NONNIL,
+                                   gate=gate, guard_mask=guard))
             else:
                 self._compile_subtree(value, child_path, CheckAnchor.NONE, gate,
                                       array_depth, guard)
@@ -418,7 +432,8 @@ class _PatternCompiler:
             n = quantity_to_micro(value)
             self._append(CheckIR(path=path, op=CheckOp.NUM_EQ, anchor=anchor,
                                  gate=gate, group=group, num_lo=n, num_hi=n,
-                                 guard_mask=guard, cond_depth=cond_depth),
+                                 guard_mask=guard, cond_depth=cond_depth,
+                                 num_mode=1 if isinstance(value, int) else 2),
                          existence)
             return
         if not isinstance(value, str):
@@ -448,7 +463,22 @@ class _PatternCompiler:
         operand = pattern[len(op.value):] if op.value and op is not Op.IN_RANGE and op is not Op.NOT_IN_RANGE else pattern
 
         if op in (Op.MORE, Op.MORE_EQUAL, Op.LESS, Op.LESS_EQUAL):
-            n = quantity_to_micro(operand.strip())
+            operand = operand.strip()
+            if not _number_part(operand):
+                # no number part: validateString with a non-equality
+                # operator is constant false (pattern.go:173) — host keeps
+                # the anchor skip/fail lattice exact for this odd case
+                raise HostOnly(f"comparison operand without number part: "
+                               f"{pattern!r}")
+            try:
+                n = quantity_to_micro(operand)
+            except QuantityError:
+                # validateNumberWithStr with a non-quantity operand falls
+                # back to a wildcard match that IGNORES the operator
+                # (pattern.go:283-288); HostOnly (valid quantity beyond the
+                # exact micro range) propagates to the CPU lane
+                return self._glob_check(operand, path, anchor, gate, group,
+                                        guard)
             num_op = {
                 Op.MORE: CheckOp.NUM_GT,
                 Op.MORE_EQUAL: CheckOp.NUM_GE,
@@ -468,22 +498,36 @@ class _PatternCompiler:
 
     def _string_check(self, operand: str, path: str, anchor: CheckAnchor,
                       gate: int, group: int, guard: int, negate: bool) -> CheckIR:
-        check = CheckIR(
+        operand = operand.strip()  # pattern.go:211 TrimSpace after operator
+        # pattern.go:212: only an operand with a leading number part takes
+        # the validateNumberWithStr path; "-5" or "abc" are pure strings
+        if _number_part(operand):
+            try:
+                n = quantity_to_micro(operand)
+            except QuantityError:
+                # wildcard fallback ignoring the operator (pattern.go:283);
+                # HostOnly (unrepresentable quantity) goes to the CPU lane
+                return self._glob_check(operand, path, anchor, gate, group,
+                                        guard)
+            check = CheckIR(
+                path=path,
+                op=CheckOp.STR_NE if negate else CheckOp.STR_EQ,
+                anchor=anchor, gate=gate, group=group, pattern_str=operand,
+                guard_mask=guard, num_fallback=True, num_lo=n, num_hi=n,
+            )
+            return check
+        return CheckIR(
             path=path,
             op=CheckOp.STR_NE if negate else CheckOp.STR_EQ,
             anchor=anchor, gate=gate, group=group, pattern_str=operand,
             guard_mask=guard,
         )
-        # operand parses as quantity -> numeric resource values compare
-        # numerically (pattern.go:264 validateNumberWithStr)
-        try:
-            n = quantity_to_micro(operand)
-            check.num_fallback = True
-            check.num_lo = n
-            check.num_hi = n
-        except (HostOnly, QuantityError):
-            pass
-        return check
+
+    def _glob_check(self, operand: str, path: str, anchor: CheckAnchor,
+                    gate: int, group: int, guard: int) -> CheckIR:
+        return CheckIR(path=path, op=CheckOp.STR_EQ, anchor=anchor,
+                       gate=gate, group=group, pattern_str=operand,
+                       guard_mask=guard)
 
 
 # ------------------------------------------------------------ aux compilers
@@ -985,6 +1029,14 @@ def wildcard_match_static(pattern: str, s: str) -> bool:
 
 
 _RANGE_RE = re.compile(r"^(\d+(?:\.\d+)?[^-!]*?)(!?-)(\d+(?:\.\d+)?.*)$")
+
+_NUMBER_PART_RE = re.compile(r"^(\d*(?:\.\d+)?)")
+
+
+def _number_part(operand: str) -> str:
+    """pattern.go:312 getNumberAndStringPartsFromPattern's number group."""
+    m = _NUMBER_PART_RE.match(operand)
+    return m.group(1) if m else ""
 
 
 def _split_range(pattern: str, op: Op) -> tuple[int, int]:

@@ -93,6 +93,7 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
     c_hi_h, c_hi_l = (jnp.asarray(x) for x in _limbs(tensors.chk_num_hi))
     c_bool = jnp.asarray(tensors.chk_bool)
     c_numfb = jnp.asarray(tensors.chk_num_fallback)
+    c_nummode = jnp.asarray(tensors.chk_num_mode.astype(np.int32))
     c_gate = jnp.asarray(tensors.chk_gate)
     c_is_gate = jnp.asarray(tensors.chk_is_gate_row)
     c_is_cond = jnp.asarray(tensors.chk_is_cond)
@@ -197,11 +198,14 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
 
             mask_c = g(mask).astype(jnp.int32)
             valid_c = g(slot_valid)
+            nbrk_c = g(null_break)
             type_c = g(type_tag).astype(jnp.int32)
             sid_c = g(str_id)
             numh_c = g(num_hi)
             numl_c = g(num_lo)
             numok_c = g(num_ok)
+            nplain_c = g(num_plain)
+            nint_c = g(num_int)
             bool_c = g(bool_val)
             elem0_c = g(elem0)
 
@@ -221,6 +225,15 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # value stringification exists only for str/bool/num leaves
             stringy = (type_c == T_STR) | (type_c == T_BOOL) | (type_c == T_NUM)
 
+            # a nil value — explicit null leaf, or a cleanly missing key
+            # (NOT a null-break, which is a structural FAIL) — converts to
+            # "0" for quantity comparison (validate/common.go:9
+            # convertNumberToString(nil)) and satisfies a null pattern
+            # (validateValueWithNilPattern); the flattener leaves the num
+            # lanes zeroed for exactly these slots
+            nil_like = (type_c == T_NULL) | (~leaf_present & ~nbrk_c)
+            numok_n = numok_c | nil_like
+
             lo_h, lo_l = c_lo_h[None, :, None], c_lo_l[None, :, None]
             hi_h, hi_l = c_hi_h[None, :, None], c_hi_l[None, :, None]
             ge_lo = ~_lex_lt(numh_c, numl_c, lo_h, lo_l)
@@ -229,10 +242,23 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             lt_lo = _lex_lt(numh_c, numl_c, lo_h, lo_l)
             eq_lo = _lex_eq(numh_c, numl_c, lo_h, lo_l)
             in_range = ge_lo & le_hi
-            num_eq = numok_c & in_range
-            use_num = c_numfb[None, :, None] & numok_c
 
-            str_eq_ok = jnp.where(use_num, num_eq, stringy & str_hit)
+            # NUM_EQ literal semantics (pattern.go:67 int / :95 float):
+            # string values must ParseInt / ParseFloat — quantity-only
+            # strings ("250m") fail even when the micro values match
+            mode = c_nummode[None, :, None]
+            numk_v = type_c == T_NUM
+            strk_v = type_c == T_STR
+            lit_str_ok = jnp.where(mode == 1, nint_c, nplain_c)
+            num_lit_ok = numok_c & (numk_v | (strk_v & lit_str_ok))
+
+            # numfb string-op rows compare quantities on both sides
+            # (validateNumberWithStr); nil converts to "0"
+            numfb = c_numfb[None, :, None]
+            num_eq = numok_n & eq_lo
+            str_eq_ok = jnp.where(numfb, num_eq, stringy & str_hit)
+            str_ne_ok = jnp.where(numfb, numok_n & ~eq_lo,
+                                  stringy & ~str_hit)
 
             op = c_op[None, :, None]
             value_ok = jnp.select(
@@ -250,37 +276,58 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                     op == CheckOp.BOOL_EQ,
                     op == CheckOp.IS_NULL,
                     op == CheckOp.EXISTS_OBJECT,
+                    op == CheckOp.EXISTS_NONNIL,
                     op == CheckOp.ABSENT,
                 ],
                 [
                     str_eq_ok,
-                    stringy & ~str_eq_ok,
-                    numok_c & eq_lo,
-                    numok_c & ~eq_lo,
-                    numok_c & gt_lo,
-                    numok_c & ge_lo,
-                    numok_c & lt_lo,
-                    numok_c & ~gt_lo,
-                    num_eq,
-                    numok_c & ~in_range,
+                    str_ne_ok,
+                    num_lit_ok & eq_lo,
+                    num_lit_ok & ~eq_lo,
+                    numok_n & gt_lo,
+                    numok_n & ge_lo,
+                    numok_n & lt_lo,
+                    numok_n & ~gt_lo,
+                    numok_n & in_range,
+                    numok_n & ~in_range,
                     (type_c == T_BOOL) & (bool_c == c_bool[None, :, None]),
-                    (type_c == T_NULL)
+                    nil_like
                     | ((type_c == T_BOOL) & ~bool_c)
                     | (numok_c & (numh_c == 0) & (numl_c == 0))
                     | ((type_c == T_STR) & empty_str[jnp.maximum(sid_c, 0)] & has_sid),
                     type_c == T_OBJ,
+                    leaf_present & (type_c != T_NULL),
                     jnp.ones_like(leaf_present),  # handled below
                 ],
                 default=jnp.zeros_like(leaf_present),
             )
 
-            absent_ok = ~leaf_present & (
+            # a null-broken chain (the walk hit an existing non-map where
+            # the pattern has a map) is a plain type-mismatch FAIL in the
+            # oracle (validateResourceElement dispatch) — it must not be
+            # rescued by guard bits or satisfy an absence anchor
+            absent_ok = ~leaf_present & ~nbrk_c & (
                 (first_absent & (c_guard[None, :, None] | leaf_bit)) != 0
             )
+            # ops that evaluate a nil value instead of failing on absence:
+            # the quantity family (nil -> "0" via validateNumberWithStr),
+            # null patterns, and numfb string ops. NUM_EQ/NUM_NE literals
+            # do NOT: validateValueWithIntPattern(nil) is plain false
+            eval_on_nil = (
+                ((op >= CheckOp.NUM_GT) & (op <= CheckOp.NUM_NOT_IN_RANGE))
+                | (op == CheckOp.IS_NULL)
+                | (((op == CheckOp.STR_EQ) | (op == CheckOp.STR_NE)) & numfb)
+            )
+            # nil evaluation applies only when every ancestor was walked
+            # and the LEAF key itself is cleanly missing; a guarded level
+            # (equality anchor) takes the absence-passes branch instead
+            nil_leaf = (~leaf_present & ~nbrk_c & ~guard_pass
+                        & (first_absent == leaf_bit))
             slot_ok = jnp.where(
                 op == CheckOp.ABSENT,
                 absent_ok,
-                jnp.where(leaf_present, value_ok, guard_pass),
+                jnp.where(leaf_present | (nil_leaf & eval_on_nil),
+                          value_ok, guard_pass & ~nbrk_c),
             )
 
             # ---- gates: per-element condition anchors in lists
@@ -310,7 +357,20 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # ---- stage 3: element reduction
             and_ok = (slot_ok | ~valid_c).all(axis=2)
             or_ok = (slot_ok & valid_c & leaf_present).any(axis=2)
-            check_ok = jnp.where(c_exist[None, :], or_ok, and_ok)   # [B, C]
+            # existence anchors: a missing anchored key silently passes
+            # (the handler returns before validating); an empty list — key
+            # present, zero slots — still fails the at-least-one check
+            tr0 = c_track[None, :, None]
+            # silent pass ONLY when the walk cleanly reached the parent map
+            # and the anchored key itself is missing; a null-broken chain
+            # or a missing ancestor is a structural FAIL before the
+            # existence handler runs
+            exist_absent_ok = (
+                (first_absent == (1 << jnp.maximum(tr0, 0)))
+                & ~nbrk_c & valid_c
+            ).any(axis=2)
+            check_ok = jnp.where(c_exist[None, :],
+                                 or_ok | exist_absent_ok, and_ok)   # [B, C]
 
             # condition rows: key present & predicate failed -> skip; an absent
             # ANCESTOR of the key is a plain pattern failure (the walk never
@@ -323,12 +383,17 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             cond_chain_fail = (c_is_cond[None, :] & cond_chain_fail_slot.any(axis=2))
 
             # anchorMap tracking: tracked key never present while its parent was
-            # validated -> fail becomes error (common/anchorKey.go:94)
+            # validated -> fail becomes error (common/anchorKey.go:94). The
+            # anchor registers only when the walk ENTERS the parent as a map:
+            # a chain that null-breaks at the tracked depth means the parent
+            # exists but is a scalar/list — validateMap never ran there, so
+            # the oracle reports a plain type-mismatch FAIL, not an error
             tr = c_track[None, :, None]
             tr_parent = (mask_c >> jnp.maximum(tr - 1, 0)) & 1 > 0
             tr_present = (mask_c >> jnp.maximum(tr, 0)) & 1 > 0
+            break_at_tr = nbrk_c & (first_absent == (1 << jnp.maximum(tr, 0)))
             registered = ((c_track[None, :] >= 0)
-                          & (tr_parent & valid_c).any(axis=2))
+                          & (tr_parent & valid_c & ~break_at_tr).any(axis=2))
             anchor_missing = registered & ~(tr_present & valid_c).any(axis=2)
 
             # ---- stage 4: group / alt / rule reduction  (work in [C, B])
@@ -354,11 +419,18 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                 track_seg, n_alts + 1,
             )[:n_alts]
 
-            # per-alt verdict
+            # per-alt verdict. A conditional-anchor skip combined with a
+            # failing plain group is ORDER-dependent in the reference
+            # (validateMap stops at the first failing handler in pattern
+            # key order) — single-pattern rules route that to the host
+            # lane; anyPattern alternatives fold skips into failures
+            # (validation.go:448-480), so they stay decisive
+            ambig = alt_skip & ~alt_ok & ~alt_is_multi[:, None]
             alt_verdict = jnp.where(
-                alt_skip, V_SKIP,
-                jnp.where(alt_ok, V_PASS,
-                          jnp.where(alt_missing, V_ERROR, V_FAIL)))
+                ambig, V_HOST,
+                jnp.where(alt_skip, V_SKIP,
+                          jnp.where(alt_ok, V_PASS,
+                                    jnp.where(alt_missing, V_ERROR, V_FAIL))))
 
             # single-pattern rules: verdict = the alt verdict.
             # anyPattern rules: any pass -> pass, else fail (skips/errors are
@@ -375,18 +447,39 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                 multi, jnp.where(rule_pass, V_PASS, V_FAIL), single_verdict
             ).T                                                    # [B, R]
 
-            # gate rows whose key is absent in some element reproduce the
-            # reference's first-failing-element anchorMap order dependency
-            # (validateArrayOfMaps stops at the first non-conditional error);
-            # a failing verdict there is resolved by the CPU oracle instead
+            # cells the device cannot score faithfully -> host lane when the
+            # verdict would be adverse:
+            # - gate rows whose key is absent in some element reproduce the
+            #   reference's first-failing-element anchorMap order dependency
+            #   (validateArrayOfMaps stops at the first non-conditional error)
+            # - list-valued leaves under scalar checks: the reference ANDs
+            #   the scalar compare over the list's elements
+            #   (validate.go:79-86), which the device cannot do for lists
+            #   the path dictionary did not expand — empty lists pass
+            #   vacuously there while the device scores a plain FAIL
             gate_key_absent = (c_is_gate[None, :] &
                                (~leaf_present & valid_c & (elem0_c >= 0)).any(axis=2))
-            rule_seg = jnp.where(c_is_gate, jnp.asarray(tensors.chk_rule), n_rules)
-            rule_gate_uncertain = _segment_or(
-                gate_key_absent.T, rule_seg, n_rules + 1)[:n_rules].T  # [B, R]
+            # a gate row whose chain null-broke (list pattern over a
+            # non-list) is a structural FAIL the reference raises before
+            # any anchor runs; the gate lattice would let it pass open
+            gate_struct = (c_is_gate[None, :] &
+                           (nbrk_c & valid_c).any(axis=2))
+            is_value_check = ~((op == CheckOp.ABSENT)
+                               | (op == CheckOp.EXISTS_OBJECT)
+                               | (op == CheckOp.EXISTS_NONNIL))[:, :, 0]
+            list_leaf = (is_value_check &
+                         ((type_c == T_LIST) & leaf_present & valid_c).any(axis=2))
+            unc_rows = gate_key_absent | list_leaf
+            rule_seg = jnp.asarray(tensors.chk_rule)
+            rule_uncertain = _segment_or(
+                unc_rows.T, rule_seg, n_rules + 1)[:n_rules].T     # [B, R]
             verdict = jnp.where(
-                rule_gate_uncertain & ((verdict == V_FAIL) | (verdict == V_ERROR)),
+                rule_uncertain & ((verdict == V_FAIL) | (verdict == V_ERROR)
+                                  | (verdict == V_SKIP)),
                 V_HOST, verdict)
+            rule_struct = _segment_or(
+                gate_struct.T, rule_seg, n_rules + 1)[:n_rules].T
+            verdict = jnp.where(rule_struct, V_HOST, verdict)
         else:
             # no pattern check rows at all (e.g. a deny-only policy
             # set): rules with alts pass vacuously (an empty pattern
@@ -526,9 +619,11 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             )
 
             # absence semantics differ by row class: match/exclude rows
-            # treat null like absent (utils.go reads fields with or-""),
-            # condition rows see a null key (-> false) vs a missing key
-            # (-> the precomputed ""-substitution result)
+            # treat null like absent (utils.go reads fields with or-"");
+            # PRECONDITION rows fold null into the ""-substitution result
+            # (the vars.go:62-74 resolver maps both to ""), while DENY rows
+            # treat null as false here — the substitution-error path
+            # (errx below) turns those cells into rule ERROR
             absres = x_absent[None, :]
             is_exist_op = ((opx == int(AuxOp.EXISTS))
                            | (opx == int(AuxOp.NOT_EXISTS)))
@@ -536,7 +631,11 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             match_val = ((is_exist_op & op_val)
                          | (~is_exist_op & pres_nonnull & op_val)
                          | (~is_exist_op & ~pres_nonnull & absres))
-            cond_val = ~nullx & ((presx & op_val) | (~presx & absres))
+            x_deny_row = jnp.asarray(ax_klass_np == AUX_DENY)[None, :]
+            cond_val_deny = ~nullx & ((presx & op_val) | (~presx & absres))
+            cond_val_pre = ((presx & ~nullx & op_val)
+                            | ((~presx | nullx) & absres))
+            cond_val = jnp.where(x_deny_row, cond_val_deny, cond_val_pre)
             is_mk = x_is_match_klass[None, :]
             has_p = x_has_path[None, :]
             rowv = (is_mk & match_val) | (~is_mk & cond_val)
@@ -560,8 +659,15 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # condition-row uncertainty compose differently in stage 6: a
             # certain match miss makes condition uncertainty irrelevant.
             is_cinop = (opx == int(AuxOp.CIN_ITEM)) | (opx == int(AuxOp.CIN_GLOB))
+            # invalid key types map to constant false PRE-negation in the
+            # reference (in.go invalid-type handling); the XOR-negate group
+            # lattice cannot express that, so negated groups with such keys
+            # take the host lane (un-negated groups already evaluate false)
+            xg_negated = axg_negate[x_group][None, :]
             unc = is_cinop & (
                 listk
+                | (typex == T_OBJ)
+                | (xg_negated & boolk)
                 | (numk & x_allow_num[None, :] & ~nintx)
                 | (x_key_pat[None, :] & strk & keyglob))
             unc = unc | ((opx == int(AuxOp.GLOB)) & presx
@@ -572,10 +678,11 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             match_unc = _segment_or(unc_m.T, x_rule, n_rules).T    # [B, R]
             cond_unc = _segment_or(unc_c.T, x_rule, n_rules).T     # [B, R]
 
-            # deny rows whose key is a missing map key: the reference's
-            # substitution fails -> rule ERROR (validation.go:299
-            # validateDeny / vars.go NotFoundVariableErr)
-            errx = x_err[None, :] & absx & x_has_path[None, :]
+            # deny rows whose key is a missing map key OR resolves to
+            # null: the reference's substitution fails in both cases ->
+            # rule ERROR (validation.go:299 validateDeny; vars.go treats
+            # a nil resolution like NotFoundVariableErr)
+            errx = x_err[None, :] & (absx | nullx) & x_has_path[None, :]
             deny_err = _segment_or(errx.T, x_rule, n_rules).T      # [B, R]
 
             # group OR -> XOR negate

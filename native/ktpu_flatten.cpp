@@ -589,6 +589,34 @@ bool needs_python_parse(const std::string& s) {
     return false;
 }
 
+// Python int(s, 10) acceptance (num_int lane for string leaves):
+// whitespace strip, optional sign, digit runs with single underscores
+// strictly between digits.
+bool py_int_ok(std::string_view s) {
+    auto is_ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+               c == '\f' || c == '\v';
+    };
+    while (!s.empty() && is_ws(s.front())) s.remove_prefix(1);
+    while (!s.empty() && is_ws(s.back())) s.remove_suffix(1);
+    if (s.empty()) return false;
+    size_t i = 0;
+    if (s[i] == '+' || s[i] == '-') ++i;
+    bool any = false;
+    bool prev_digit = false;
+    while (i < s.size()) {
+        char c = s[i];
+        if (c >= '0' && c <= '9') { any = true; prev_digit = true; ++i; }
+        else if (c == '_') {
+            if (!prev_digit || i + 1 >= s.size() ||
+                s[i + 1] < '0' || s[i + 1] > '9') return false;
+            prev_digit = false;
+            ++i;
+        } else return false;
+    }
+    return any;
+}
+
 // ------------------------------------------------------------------ ctx
 
 struct Ctx {
@@ -633,7 +661,8 @@ void walk_slots(const Value* node, const std::vector<std::string>& segs,
     uint16_t bit = uint16_t(1u << (i + 1 + offset));
     if (seg == "*") {
         if (node == nullptr || node->t != Value::Arr) {
-            out.push_back({mask, elem0, nullptr, false, false});
+            // list pattern over an existing non-list node: structural break
+            out.push_back({mask, elem0, nullptr, false, true});
             return;
         }
         int32_t idx = 0;
@@ -898,15 +927,18 @@ int ktpu_flatten_batch(
                         }
                         int64_t micro;
                         bool capped = false;
-                        if (quantity_to_micro(v->str, &micro, &capped)) {
-                            num_val[o] = micro;
-                            num_ok[o] = 1;
-                            if (py_float_ok(v->str)) num_plain[o] = 1;
-                        }
-                        else if (capped) {
+                        const bool q_ok =
+                            quantity_to_micro(v->str, &micro, &capped);
+                        if (!q_ok && capped) {
                             // >36-digit number part: exact range exceeded
                             host_flag[b] = 1;
                             break;
+                        }
+                        num_int[o] = py_int_ok(v->str) ? 1 : 0;
+                        if (q_ok) {
+                            num_val[o] = micro;
+                            num_ok[o] = 1;
+                            if (py_float_ok(v->str)) num_plain[o] = 1;
                         }
                         int64_t dmicro;
                         if (duration_micro(v->str, &dmicro)) {
