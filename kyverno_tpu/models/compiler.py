@@ -297,7 +297,10 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
                 if is_cond:
                     track_depth = c.cond_depth
                 elif c.existence:
-                    track_depth = segments.index("*") if "*" in segments else len(segments)
+                    # the existence anchor's own '*' (the LAST one): its
+                    # preceding segment is the anchored key
+                    track_depth = (len(segments) - 1 - segments[::-1].index("*")
+                                   if "*" in segments else len(segments))
                 elif is_gate or c.op is CheckOp.ABSENT:
                     track_depth = len(segments)
                 else:

@@ -303,6 +303,8 @@ class _PatternCompiler:
                 self._emit(CheckIR(path=child_path, op=CheckOp.ABSENT, gate=gate,
                                    guard_mask=guard))
             elif kind is Anchor.EXISTENCE:
+                if array_depth > 0:
+                    raise HostOnly("existence anchor inside an array")
                 self._walk_existence(value, child_path)
             elif kind is Anchor.ADD_IF_NOT_PRESENT:
                 raise HostOnly("+() anchor is mutate-only")
@@ -439,6 +441,10 @@ class _PatternCompiler:
         if not isinstance(value, str):
             raise HostOnly(f"unsupported leaf pattern type {type(value).__name__}")
 
+        if "&" in value and "|" in value:
+            # mixed compound: (a AND b) OR c — an OR of ANDs the two-level
+            # group lattice (rows OR in group, groups AND) cannot express
+            raise HostOnly("mixed &/| compound pattern")
         if "&" in value:
             # AND-compound: each part its own group (pattern.go:165)
             for part in value.split("&"):
@@ -474,11 +480,11 @@ class _PatternCompiler:
                 n = quantity_to_micro(operand)
             except QuantityError:
                 # validateNumberWithStr with a non-quantity operand falls
-                # back to a wildcard match that IGNORES the operator
-                # (pattern.go:283-288); HostOnly (valid quantity beyond the
-                # exact micro range) propagates to the CPU lane
-                return self._glob_check(operand, path, anchor, gate, group,
-                                        guard)
+                # back to a wildcard over convertNumberToString(value) —
+                # fixed-point "%f" floats, nil -> "0" — a stringification
+                # the device dictionary does not carry (pattern.go:283-288)
+                raise HostOnly(
+                    f"number-part operand without quantity form: {operand!r}")
             num_op = {
                 Op.MORE: CheckOp.NUM_GT,
                 Op.MORE_EQUAL: CheckOp.NUM_GE,
@@ -505,10 +511,11 @@ class _PatternCompiler:
             try:
                 n = quantity_to_micro(operand)
             except QuantityError:
-                # wildcard fallback ignoring the operator (pattern.go:283);
-                # HostOnly (unrepresentable quantity) goes to the CPU lane
-                return self._glob_check(operand, path, anchor, gate, group,
-                                        guard)
+                # wildcard fallback over convertNumberToString(value)
+                # (pattern.go:283, operator ignored) -> host lane, like the
+                # comparison-op branch above
+                raise HostOnly(
+                    f"number-part operand without quantity form: {operand!r}")
             check = CheckIR(
                 path=path,
                 op=CheckOp.STR_NE if negate else CheckOp.STR_EQ,
@@ -523,11 +530,7 @@ class _PatternCompiler:
             guard_mask=guard,
         )
 
-    def _glob_check(self, operand: str, path: str, anchor: CheckAnchor,
-                    gate: int, group: int, guard: int) -> CheckIR:
-        return CheckIR(path=path, op=CheckOp.STR_EQ, anchor=anchor,
-                       gate=gate, group=group, pattern_str=operand,
-                       guard_mask=guard)
+
 
 
 # ------------------------------------------------------------ aux compilers

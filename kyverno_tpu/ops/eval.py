@@ -293,7 +293,8 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                     (type_c == T_BOOL) & (bool_c == c_bool[None, :, None]),
                     nil_like
                     | ((type_c == T_BOOL) & ~bool_c)
-                    | (numok_c & (numh_c == 0) & (numl_c == 0))
+                    | ((type_c == T_NUM) & numok_c
+                       & (numh_c == 0) & (numl_c == 0))
                     | ((type_c == T_STR) & empty_str[jnp.maximum(sid_c, 0)] & has_sid),
                     type_c == T_OBJ,
                     leaf_present & (type_c != T_NULL),
@@ -365,10 +366,9 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # and the anchored key itself is missing; a null-broken chain
             # or a missing ancestor is a structural FAIL before the
             # existence handler runs
-            exist_absent_ok = (
-                (first_absent == (1 << jnp.maximum(tr0, 0)))
-                & ~nbrk_c & valid_c
-            ).any(axis=2)
+            exist_clean_miss = (first_absent == (1 << jnp.maximum(tr0, 0))) & ~nbrk_c
+            exist_absent_ok = ((exist_clean_miss | ~valid_c).all(axis=2)
+                               & valid_c.any(axis=2))
             check_ok = jnp.where(c_exist[None, :],
                                  or_ok | exist_absent_ok, and_ok)   # [B, C]
 
@@ -397,12 +397,19 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             anchor_missing = registered & ~(tr_present & valid_c).any(axis=2)
 
             # ---- stage 4: group / alt / rule reduction  (work in [C, B])
+            # rows OR within a group ("a | b" compound alternatives,
+            # pattern.go:153), groups AND within an alternative; a group
+            # with no plain rows (gate/cond masks only) never constrains
             seg_ok = check_ok.T
-            # exclude gate + cond rows from the group AND (they are masks)
             is_plain = ~(c_is_gate | c_is_cond)
+            has_plain_np = np.zeros(n_groups, dtype=bool)
+            has_plain_np[tensors.chk_group_gid[
+                np.asarray(~(tensors.chk_is_gate_row | tensors.chk_is_cond))]] = True
+            has_plain = jnp.asarray(has_plain_np)
             plain_seg = jnp.where(is_plain, c_group, n_groups)
-            group_ok = _segment_and(jnp.where(is_plain[:, None], seg_ok, True),
-                                    plain_seg, n_groups + 1)[:n_groups]  # [G, B]
+            group_or = _segment_or(jnp.where(is_plain[:, None], seg_ok, False),
+                                   plain_seg, n_groups + 1)[:n_groups]  # [G, B]
+            group_ok = group_or | ~has_plain[:, None]
             alt_ok = _segment_and(group_ok, group_alt, n_alts)            # [A, B]
 
             cond_seg = jnp.where(c_is_cond, c_alt, n_alts)
@@ -426,11 +433,17 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # lane; anyPattern alternatives fold skips into failures
             # (validation.go:448-480), so they stay decisive
             ambig = alt_skip & ~alt_ok & ~alt_is_multi[:, None]
+            # anchor-missing failures are ALSO order-dependent: the
+            # reference registers an anchor only when the walk reaches its
+            # map (anchorKey.go:107 CheckAnchorInResource), and an earlier
+            # sibling mismatch aborts the walk first — whether the failure
+            # reports FAIL or ERROR depends on pattern key order, so the
+            # oracle decides
             alt_verdict = jnp.where(
                 ambig, V_HOST,
                 jnp.where(alt_skip, V_SKIP,
                           jnp.where(alt_ok, V_PASS,
-                                    jnp.where(alt_missing, V_ERROR, V_FAIL))))
+                                    jnp.where(alt_missing, V_HOST, V_FAIL))))
 
             # single-pattern rules: verdict = the alt verdict.
             # anyPattern rules: any pass -> pass, else fail (skips/errors are
