@@ -416,6 +416,11 @@ class _PatternCompiler:
                    cond_depth: int = -1) -> None:
         """One scalar pattern leaf -> one or more check rows (compound
         ``a|b`` patterns OR into the same group; pattern.go:153)."""
+        if (existence_group is not None and isinstance(value, str)
+                and ("&" in value or "|" in value)):
+            # the at-least-one-element OR and the compound split cannot
+            # share the two-level group lattice
+            raise HostOnly("compound pattern under existence anchor")
         group = existence_group if existence_group is not None else self.next_group()
         existence = existence_group is not None
 

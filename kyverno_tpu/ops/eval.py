@@ -131,6 +131,24 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
     n_rules = max(tensors.n_rules, 1)
     n_gates = max(tensors.n_gates, 1)
 
+    # static group-level maps: a compound "a | b" leaf splits into rows
+    # sharing one group, so gate/cond state reduces rows-OR-in-group first,
+    # then groups-AND within the gate / OR into the alt
+    _gate_rows_np = np.asarray(tensors.chk_is_gate_row)
+    _cond_rows_np = np.asarray(tensors.chk_is_cond)
+    group_gate_np = np.full(n_groups, -1, dtype=np.int32)
+    group_gate_np[tensors.chk_group_gid[_gate_rows_np]] = \
+        tensors.chk_gate[_gate_rows_np]
+    group_is_gate = jnp.asarray(group_gate_np >= 0)
+    group_gate_seg = jnp.asarray(
+        np.where(group_gate_np >= 0, group_gate_np, n_gates))
+    cond_group_np = np.zeros(n_groups, dtype=bool)
+    cond_group_np[tensors.chk_group_gid[_cond_rows_np]] = True
+    cond_group = jnp.asarray(cond_group_np)
+    has_plain_np = np.zeros(n_groups, dtype=bool)
+    has_plain_np[tensors.chk_group_gid[~(_gate_rows_np | _cond_rows_np)]] = True
+    has_plain = jnp.asarray(has_plain_np)
+
     # static: which rules have at least one device alternative (computed on
     # host — an on-device scatter over empty alt_rule aborts the TPU backend)
     covered_np = np.zeros(n_rules, dtype=bool)
@@ -331,18 +349,24 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                           value_ok, guard_pass & ~nbrk_c),
             )
 
-            # ---- gates: per-element condition anchors in lists
+            # ---- gates: per-element condition anchors in lists.
+            # Two-level reduction: compound-alternative rows OR within
+            # their group, predicate groups AND within the gate. Rows of a
+            # group share one path, so slot validity is uniform; an
+            # invalid slot keeps the gate neutrally open.
             gate_row_open = ~leaf_present | value_ok              # absent key opens
-            gate_rows = jnp.where(
-                c_is_gate[None, :, None],
-                gate_row_open | ~valid_c,
-                jnp.ones_like(gate_row_open),
-            )
-            # reduce gate rows -> gate_open [B, G, E0max]; gate rows have one
-            # wildcard so slot index == element index
-            gate_seg = jnp.where(c_is_gate, c_gate, n_gates)      # dump non-gates
+
+            def flat(x):
+                return x.swapaxes(0, 1).reshape(C, B * E)
+
+            gate_gseg = jnp.where(c_is_gate, c_group, n_groups)
+            ggrp_open = _segment_or(
+                jnp.where(c_is_gate[:, None],
+                          flat(gate_row_open | ~valid_c), False),
+                gate_gseg, n_groups + 1)[:n_groups]                # [G, B*E]
             gate_open = _segment_and(
-                gate_rows.swapaxes(0, 1).reshape(C, B * E), gate_seg, n_gates + 1
+                jnp.where(group_is_gate[:, None], ggrp_open, True),
+                group_gate_seg, n_gates + 1
             )[:n_gates].reshape(n_gates, B, E)
 
             # gather gate state for gated checks by top-level element index
@@ -372,15 +396,30 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             check_ok = jnp.where(c_exist[None, :],
                                  or_ok | exist_absent_ok, and_ok)   # [B, C]
 
-            # condition rows: key present & predicate failed -> skip; an absent
-            # ANCESTOR of the key is a plain pattern failure (the walk never
-            # reaches the anchor), not a skip
+            # condition rows: key present & predicate failed -> skip; an
+            # absent ANCESTOR of the key is a plain pattern failure (the
+            # walk never reaches the anchor), not a skip. A compound
+            # predicate fails only when EVERY alternative row of its group
+            # misses, so the ok-OR reduces rows -> group first.
             cond_bit = (1 << jnp.maximum(c_cond_depth, 0))[None, :, None]
             cond_key_present = (mask_c & cond_bit) != 0
-            cond_fail_slot = cond_key_present & ~(leaf_present & value_ok) & valid_c
-            cond_fail = (c_is_cond[None, :] & cond_fail_slot.any(axis=2))
+            cond_gseg = jnp.where(c_is_cond, c_group, n_groups)
+            cgrp_ok = _segment_or(
+                jnp.where(c_is_cond[:, None],
+                          flat(leaf_present & value_ok), False),
+                cond_gseg, n_groups + 1)[:n_groups]
+            cgrp_kp = _segment_or(
+                jnp.where(c_is_cond[:, None],
+                          flat(cond_key_present & valid_c), False),
+                cond_gseg, n_groups + 1)[:n_groups]
+            cond_fail_g = (cgrp_kp & ~cgrp_ok).reshape(
+                n_groups, B, E).any(axis=2)                        # [G, B]
             cond_chain_fail_slot = (first_absent != 0) & (first_absent < cond_bit) & valid_c
-            cond_chain_fail = (c_is_cond[None, :] & cond_chain_fail_slot.any(axis=2))
+            cond_chain_g = _segment_or(
+                jnp.where(c_is_cond[:, None],
+                          flat(cond_chain_fail_slot), False),
+                cond_gseg, n_groups + 1)[:n_groups].reshape(
+                n_groups, B, E).any(axis=2)                        # [G, B]
 
             # anchorMap tracking: tracked key never present while its parent was
             # validated -> fail becomes error (common/anchorKey.go:94). The
@@ -402,22 +441,18 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # with no plain rows (gate/cond masks only) never constrains
             seg_ok = check_ok.T
             is_plain = ~(c_is_gate | c_is_cond)
-            has_plain_np = np.zeros(n_groups, dtype=bool)
-            has_plain_np[tensors.chk_group_gid[
-                np.asarray(~(tensors.chk_is_gate_row | tensors.chk_is_cond))]] = True
-            has_plain = jnp.asarray(has_plain_np)
             plain_seg = jnp.where(is_plain, c_group, n_groups)
             group_or = _segment_or(jnp.where(is_plain[:, None], seg_ok, False),
                                    plain_seg, n_groups + 1)[:n_groups]  # [G, B]
             group_ok = group_or | ~has_plain[:, None]
             alt_ok = _segment_and(group_ok, group_alt, n_alts)            # [A, B]
 
-            cond_seg = jnp.where(c_is_cond, c_alt, n_alts)
-            alt_skip = _segment_or(jnp.where(c_is_cond[:, None], cond_fail.T, False),
-                                   cond_seg, n_alts + 1)[:n_alts]
+            alt_skip = _segment_or(
+                jnp.where(cond_group[:, None], cond_fail_g, False),
+                group_alt, n_alts)
             alt_chain_fail = _segment_or(
-                jnp.where(c_is_cond[:, None], cond_chain_fail.T, False),
-                cond_seg, n_alts + 1)[:n_alts]
+                jnp.where(cond_group[:, None], cond_chain_g, False),
+                group_alt, n_alts)
             alt_ok = alt_ok & ~alt_chain_fail
 
             track_seg = jnp.where(c_track >= 0, c_alt, n_alts)

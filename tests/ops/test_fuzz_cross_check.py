@@ -28,21 +28,32 @@ SCALARS = [True, False, 0, 1, 7, 250, -3, 2.5, 0.1, "on", "off", "3",
 
 def rand_leaf_pattern(rng):
     r = rng.random()
-    if r < 0.35:
+    if r < 0.30:
         v = rng.choice(VALUES)
         if rng.random() < 0.3:
             v = rng.choice(["*", "?*", "*-lane", "x?", "!off", "!*fast*"])
         return v
-    if r < 0.55:
+    if r < 0.45:
         op = rng.choice([">", ">=", "<", "<=", "!"])
         return f"{op}{rng.choice(['1', '5', '250m', '0.5', '1Gi'])}"
-    if r < 0.65:
+    if r < 0.52:
         return f"{rng.randint(0, 5)}-{rng.randint(5, 100)}"
-    if r < 0.75:
+    if r < 0.62:
         return " | ".join(rng.choice(VALUES) for _ in range(2))
-    if r < 0.85:
+    if r < 0.68:
+        return " & ".join(rng.choice([">1", "<=250m", "?*", "on"])
+                          for _ in range(2))
+    if r < 0.72:
+        # mixed compound / number-part-no-quantity operands: host-only
+        # constructs must still agree via the oracle fallback
+        return rng.choice(["on & off | ok", "0*", "!1x2", ">1x"])
+    if r < 0.78:
+        return None  # null pattern (validateValueWithNilPattern)
+    if r < 0.86:
         return rng.choice([True, False])
-    return rng.randint(0, 100)
+    if r < 0.93:
+        return rng.randint(0, 100)
+    return rng.choice([0.25, 2.5, 9.0])
 
 
 def rand_pattern(rng, depth=0):
