@@ -19,6 +19,9 @@ def main(argv=None) -> int:
     apply_cmd.register(subparsers)
     test_cmd.register(subparsers)
     validate_cmd.register(subparsers)
+    # `version` verb parity (pkg/kyverno/version/command.go)
+    version_p = subparsers.add_parser("version", help="print version")
+    version_p.set_defaults(func=lambda _a: print(f"Version: {__version__}") or 0)
 
     args = parser.parse_args(argv)
     if not getattr(args, "func", None):

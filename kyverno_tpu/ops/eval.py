@@ -414,7 +414,13 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                 cond_gseg, n_groups + 1)[:n_groups]
             cond_fail_g = (cgrp_kp & ~cgrp_ok).reshape(
                 n_groups, B, E).any(axis=2)                        # [G, B]
-            cond_chain_fail_slot = (first_absent != 0) & (first_absent < cond_bit) & valid_c
+            # chain failures: a cleanly absent ANCESTOR, or a null-break AT
+            # the anchored key's level — the parent of the anchor exists
+            # but is not a map, a structural FAIL the reference raises
+            # before the anchor handler runs
+            cond_chain_fail_slot = (
+                ((first_absent != 0) & (first_absent < cond_bit) & valid_c)
+                | (nbrk_c & (first_absent == cond_bit) & valid_c))
             cond_chain_g = _segment_or(
                 jnp.where(c_is_cond[:, None],
                           flat(cond_chain_fail_slot), False),

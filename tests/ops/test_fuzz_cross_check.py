@@ -94,8 +94,12 @@ def rand_policy(rng, i):
             "match": {"resources": {"kinds": [rng.choice(
                 ["Pod", "ConfigMap", "*"])]}}}
     r = rng.random()
-    if r < 0.5:
+    if r < 0.4:
         rule["validate"] = {"pattern": {"data": rand_pattern(rng)}}
+    elif r < 0.55:
+        rule["validate"] = {"anyPattern": [
+            {"data": rand_pattern(rng)}
+            for _ in range(rng.randint(2, 3))]}
     elif r < 0.75:
         rule["validate"] = {"deny": {"conditions": {
             rng.choice(["any", "all"]): [rand_condition(rng)
