@@ -1,0 +1,113 @@
+"""Property fuzz for the mutation tier.
+
+Mutate has no second implementation to cross-check against, so invariants
+stand in for an oracle:
+
+1. patch consistency — the RFC6902 ops the engine returns are the
+   admission contract (the API server applies them to the original
+   object); applying them must reproduce engine's patched resource
+   exactly (generatePatches round-trip, mutate/patchesUtils.go).
+2. idempotence — re-running the same strategic-merge policy over its own
+   output must be a no-op (kustomize merge semantics; +() anchors only
+   add when absent, so a second pass changes nothing).
+3. validate agreement — the patched resource must satisfy the policy's
+   own pattern when that pattern is anchor-free (what you merge is what
+   you then match).
+"""
+
+import random
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.mutate.json_patch import apply_patch_ops
+from kyverno_tpu.engine.mutation import mutate
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.engine.response import RuleStatus
+from kyverno_tpu.engine.validate_pattern import match_pattern
+from kyverno_tpu.utils.jsoncopy import json_copy
+
+KEYS = ["alpha", "beta", "gamma", "labels", "mode"]
+VALS = ["on", "off", "x1", "3", "250m", ""]
+
+
+def rand_sm_pattern(rng, depth=0):
+    """Strategic-merge pattern: maps with plain and +(add) keys. Bare keys
+    stay unique — a map carrying the same key both plain and +()-anchored
+    is contradictory input with no consistent fixpoint."""
+    if depth >= 2 or rng.random() < 0.45:
+        return rng.choice(VALS + [True, False, 7])
+    out = {}
+    for key in rng.sample(KEYS, rng.randint(1, 3)):
+        if rng.random() < 0.4:
+            key = f"+({key})"
+        out[key] = rand_sm_pattern(rng, depth + 1)
+    return out
+
+
+def rand_resource(rng, i):
+    def val(depth=0):
+        if depth >= 2 or rng.random() < 0.55:
+            return rng.choice(VALS + [True, 0, 5, None])
+        return {rng.choice(KEYS): val(depth + 1)
+                for _ in range(rng.randint(0, 3))}
+
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": f"cm-{i}"},
+            "data": {rng.choice(KEYS): val()
+                     for _ in range(rng.randint(0, 3))}}
+
+
+def run_mutate(policy, resource):
+    jctx = Context()
+    jctx.add_resource(resource)
+    return mutate(PolicyContext(policy=policy, new_resource=json_copy(resource),
+                                json_context=jctx))
+
+
+@pytest.mark.parametrize("seed", range(1, 9))
+def test_mutate_invariants(seed):
+    rng = random.Random(990 + seed)
+    for i in range(12):
+        pattern = {"data": rand_sm_pattern(rng)}
+        policy = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": f"m-{i}"},
+            "spec": {"rules": [{
+                "name": f"m-{i}-r",
+                "match": {"resources": {"kinds": ["ConfigMap"]}},
+                "mutate": {"patchStrategicMerge": pattern},
+            }]},
+        })
+        for j in range(6):
+            resource = rand_resource(rng, j)
+            resp = run_mutate(policy, resource)
+            statuses = [r.status for r in resp.policy_response.rules]
+            if RuleStatus.ERROR in statuses:
+                continue
+
+            # 1. patch consistency
+            replayed = apply_patch_ops(resource, resp.patches)
+            assert replayed == resp.patched_resource, (
+                f"seed={seed} patches diverge from patched resource\n"
+                f"pattern={pattern}\nresource={resource}\n"
+                f"patches={resp.patches}")
+
+            # 2. idempotence
+            resp2 = run_mutate(policy, resp.patched_resource)
+            assert resp2.patched_resource == resp.patched_resource, (
+                f"seed={seed} not idempotent\npattern={pattern}\n"
+                f"first={resp.patched_resource}\n"
+                f"second={resp2.patched_resource}")
+            assert resp2.patches == [], (
+                f"seed={seed} second pass emitted patches: {resp2.patches}")
+
+            # 3. validate agreement (anchor-free patterns only: +() keys
+            # are add-if-absent, so their value may legitimately differ)
+            if "+(" not in str(pattern):
+                check = match_pattern(resp.patched_resource, pattern)
+                assert check.matched, (
+                    f"seed={seed} merged resource fails its own pattern\n"
+                    f"pattern={pattern}\npatched={resp.patched_resource}\n"
+                    f"message={check.message}")
