@@ -63,15 +63,22 @@ class CompiledPolicySet:
         self.rule_irs = rule_irs
         self.tensors: PolicyTensors = compile_tensors(rule_irs)
         self._eval_fn = None
+        import threading
+
+        self._eval_fn_lock = threading.Lock()
 
     # ------------------------------------------------------------ device
 
     @property
     def eval_fn(self):
+        # double-checked: the admission flush pool and the warmup thread
+        # may race here; building the jaxpr twice wastes seconds of trace
         if self._eval_fn is None:
-            from ..ops.eval import build_eval_fn
+            with self._eval_fn_lock:
+                if self._eval_fn is None:
+                    from ..ops.eval import build_eval_fn
 
-            self._eval_fn = build_eval_fn(self.tensors)
+                    self._eval_fn = build_eval_fn(self.tensors)
         return self._eval_fn
 
     def flatten(self, resources: list[dict]) -> FlatBatch:

@@ -93,6 +93,40 @@ class FlatBatch:
         return tuple(getattr(self, k) for k in BATCH_ARRAYS + DICT_ARRAYS)
 
 
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def pad_to_buckets(batch: FlatBatch) -> tuple["FlatBatch", int]:
+    """Pad the data-dependent axes (batch B, slots-per-path E, dictionary V)
+    up to powers of two so XLA compiles one kernel per shape *bucket*
+    instead of one per distinct admission batch. Padded batch rows carry
+    ``live=False``; padded slots carry ``slot_valid=False`` (the natural
+    encoding for unused slots); padded dictionary rows are never gathered
+    because no slot references their ids. Returns (padded, original_n)."""
+    from dataclasses import replace
+
+    b, e = batch.n, batch.e
+    v = int(batch.str_len.shape[0])
+    b2, e2, v2 = _next_pow2(b), _next_pow2(e), _next_pow2(v)
+    if (b2, e2, v2) == (b, e, v):
+        return batch, b
+
+    updates: dict = {"n": b2, "e": e2}
+    for name in BATCH_ARRAYS + ("num_val",):
+        x = getattr(batch, name)
+        width = [(0, b2 - b)] + [(0, 0)] * (x.ndim - 1)
+        if x.ndim == 3:
+            width[2] = (0, e2 - e)
+        fill = -1 if name in ("kind_id", "str_id", "elem0") else 0
+        updates[name] = np.pad(x, width, constant_values=fill)
+    for name in DICT_ARRAYS:
+        x = getattr(batch, name)
+        width = [(0, v2 - v)] + [(0, 0)] * (x.ndim - 1)
+        updates[name] = np.pad(x, width, constant_values=0)
+    return replace(batch, **updates), b
+
+
 class _Interner:
     def __init__(self):
         self.index: dict[str, int] = {}

@@ -12,12 +12,26 @@ PASS/SKIP/NOT_APPLICABLE is admitted without touching the CPU engine (the
 common case); any FAIL/ERROR/HOST cell routes that one resource to the
 full oracle for faithful rule messages and context-dependent semantics.
 Wrong-way cost is therefore latency only, never correctness.
+
+The screen is also *latency-aware and self-calibrating*: a lone request
+routes straight to the CPU oracle instead of paying the micro-batch
+window plus a device round trip for a batch of one — the device only
+wins when there is a batch to amortize it over. The router compares a
+measured EMA of device dispatch cost (updated by every flush, kept fresh
+by occasional *shadow probes* that never block a request) against the
+measured CPU-oracle cost times the current admission concurrency; on a
+host-local chip the device engages for small bursts, while behind a
+high-RTT link it correctly stays on the oracle. The whole exchange is
+bounded by a deadline budget derived from the admission webhook timeout
+(/root/reference/pkg/webhookconfig/configmanager.go:33).
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
@@ -26,6 +40,13 @@ from ..models import Verdict
 
 CLEAN = "clean"          # every cell PASS/SKIP/NOT_APPLICABLE
 ATTENTION = "attention"  # some cell FAIL/ERROR/HOST -> oracle lane
+ORACLE = "oracle"        # low arrival rate -> skip the device entirely
+
+# default admission webhook timeout (configmanager.go:33); the screen's
+# deadline budget is a fraction of it so the oracle lane always has time
+# to answer within the API server's patience even after a device miss
+WEBHOOK_TIMEOUT_S = 10.0
+SCREEN_DEADLINE_S = WEBHOOK_TIMEOUT_S / 4
 
 
 def verdict_to_status(verdict: Verdict):
@@ -50,25 +71,153 @@ class AdmissionBatcher:
     """Micro-batching device screen over policy_cache.compiled() sets."""
 
     def __init__(self, policy_cache, window_s: float = 0.004,
-                 max_batch: int = 512):
+                 max_batch: int = 512, burst_threshold: int = 4,
+                 rate_window_s: float = 0.05,
+                 oracle_cost_init_s: float = 0.002,
+                 dispatch_cost_init_s: float = 0.150,
+                 probe_interval_s: float = 10.0):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
+        # a device dispatch only pays off once this many requests are
+        # concurrently in flight; below that the CPU oracle beats the
+        # micro-batch window + device round trip for a batch of one
+        self.burst_threshold = burst_threshold
+        self.rate_window_s = rate_window_s
+        self.probe_interval_s = probe_interval_s
+        # cost model (seconds), self-calibrating: dispatch starts
+        # pessimistic so a remote/tunneled chip is never trusted until a
+        # shadow probe has actually measured it; oracle cost is tracked
+        # per policy so the model scales with the enforce set size, and
+        # the screen's value is discounted by the measured fraction of
+        # oracle work it actually eliminates (a screen that mostly returns
+        # ATTENTION saves little)
+        self._oracle_policy_cost = oracle_cost_init_s
+        self._dispatch_cost = dispatch_cost_init_s
+        self._savings_frac = 0.5
+        # realized flush size: a dispatch only amortizes over the batch
+        # that actually formed, not over the instantaneous concurrency
+        self._batch_size_ema = 4.0
+        self._last_dispatch = 0.0
+        self.stats = {"oracle": 0, "device": 0, "probe": 0,
+                      "clean": 0, "attention": 0}
+        # per-CompiledPolicySet shape buckets already compiled; weak keys
+        # so dead policy generations vanish (an id()-keyed set could both
+        # leak and misclassify a fresh compile after id reuse)
+        import weakref
+
+        self._seen_shapes: weakref.WeakKeyDictionary = (
+            weakref.WeakKeyDictionary())
+        self._in_flight = 0
+        self._arrivals: deque[float] = deque()
         self._lock = threading.Condition()
         self._buckets: dict[tuple, _Bucket] = {}
         self._stopped = False
+        # flushes run on a small pool so consecutive device dispatches
+        # pipeline (transfer of batch N+1 overlaps eval of batch N — the
+        # win is largest when the chip sits behind a high-RTT link)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._flush_pool = ThreadPoolExecutor(max_workers=4,
+                                              thread_name_prefix="adm-flush")
         self._worker = threading.Thread(target=self._run, name="adm-batch",
                                         daemon=True)
         self._worker.start()
 
+    # ------------------------------------------------------------ routing
+
+    @contextlib.contextmanager
+    def admission_in_flight(self):
+        """Webhook handlers wrap each admission in this so the router sees
+        true request concurrency (goroutine count in the reference,
+        server.go:233) rather than inferring it from arrival rate."""
+        with self._lock:
+            self._in_flight += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._in_flight -= 1
+
+    def note_oracle_cost(self, seconds: float, n_policies: int = 1,
+                         full: bool = True) -> None:
+        """The webhook reports measured CPU-oracle time per admission and
+        how many policies that run covered. Only *full* runs update the
+        per-policy EMA — hybrid runs over the few flagged policies carry
+        per-request fixed overhead that would inflate the estimate."""
+        if n_policies <= 0 or not full:
+            return
+        with self._lock:
+            per = seconds / n_policies
+            self._oracle_policy_cost += 0.3 * (per - self._oracle_policy_cost)
+
+    def note_screen_savings(self, frac: float) -> None:
+        """Fraction of oracle *time* a screened admission avoided
+        (1.0 for a CLEAN row)."""
+        with self._lock:
+            self._savings_frac += 0.3 * (frac - self._savings_frac)
+
+    def note_hybrid_cost(self, seconds: float, n_enforce: int) -> None:
+        """A hybrid merge still paid ``seconds`` of CPU; convert that to a
+        time-savings fraction against the estimated full-oracle cost —
+        policy counts overstate savings because per-request fixed work
+        (context build, userinfo) doesn't scale with policy count."""
+        with self._lock:
+            full = n_enforce * self._oracle_policy_cost
+            frac = max(0.0, 1.0 - seconds / full) if full > 0 else 0.0
+            self._savings_frac += 0.3 * (frac - self._savings_frac)
+
+    def _device_favored(self, est_batch: int, n_policies: int) -> bool:
+        # amortize over the batch size dispatches actually realize, not
+        # the instantaneous concurrency (the window only captures what
+        # arrives within it); allow 1.5x headroom so the lane can grow
+        eff_batch = min(float(est_batch),
+                        max(1.0, 1.5 * self._batch_size_ema))
+        saved = (eff_batch * n_policies * self._oracle_policy_cost
+                 * self._savings_frac)
+        return self._dispatch_cost + self.window_s < saved
+
+    def warmup(self, ptype, kind: str, namespace: str, resource: dict,
+               batch_sizes: tuple = (1, 16)) -> None:
+        """Pre-compile the screen kernel for the common shape buckets and
+        prime the dispatch-cost EMA — the controller calls this at startup
+        and after policy changes (the north star's 'precompiled policy
+        tensor at controller start'), so the first real burst never pays
+        XLA compilation inline."""
+        from ..models.flatten import pad_to_buckets
+
+        try:
+            cps = self.policy_cache.compiled(ptype, kind, namespace)
+        except Exception:
+            return
+        if not cps.policies:
+            return
+        for b in batch_sizes:
+            try:
+                batch, _ = pad_to_buckets(cps.flatten([resource] * b))
+                shape_key = (batch.n, batch.e, int(batch.str_len.shape[0]))
+                cps.evaluate_device(batch)          # compile
+                t0 = time.monotonic()
+                cps.evaluate_device(batch)          # measure steady state
+                dt = time.monotonic() - t0
+            except Exception:
+                continue
+            with self._lock:
+                self._seen_shapes.setdefault(cps, set()).add(shape_key)
+                self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
+                self._last_dispatch = time.monotonic()
+
     # ------------------------------------------------------------ enqueue
 
     def screen(self, ptype, kind: str, namespace: str, resource: dict,
-               timeout_s: float = 2.0):
-        """Returns (CLEAN | ATTENTION, [(policy, rule, Verdict), ...]).
+               timeout_s: float = SCREEN_DEADLINE_S):
+        """Returns (CLEAN | ATTENTION | ORACLE, [(policy, rule, Verdict), ...]).
 
-        On any failure — timeout, compile error, device error — returns
-        (ATTENTION, []) so the caller takes the oracle lane."""
+        ORACLE means "the device does not pay for this request — evaluate
+        on CPU inline"; the caller treats it exactly like ATTENTION but no
+        time was spent. On any failure — timeout, compile error, device
+        error — returns (ATTENTION, []) so the caller takes the oracle
+        lane."""
         try:
             cps = self.policy_cache.compiled(ptype, kind, namespace)
         except Exception:
@@ -76,19 +225,69 @@ class AdmissionBatcher:
         if not cps.policies:
             return CLEAN, []
         fut: Future = Future()
+        now = time.monotonic()
         with self._lock:
             if self._stopped:
                 return ATTENTION, []
+            self._arrivals.append(now)
+            while self._arrivals and now - self._arrivals[0] > self.rate_window_s:
+                self._arrivals.popleft()
+            # concurrency estimate: true in-flight count when the webhook
+            # wraps admissions, else the recent-arrival window (direct
+            # callers); a sequential client always estimates 1 and a
+            # device batch of one never beats the oracle
+            est_batch = (self._in_flight if self._in_flight > 0
+                         else len(self._arrivals))
             key = (int(ptype), kind, namespace, id(cps))
             bucket = self._buckets.get(key)
+            # ride an already-forming batch regardless of the cost model:
+            # joining costs only the remainder of the open window
+            joining = bucket is not None and bool(bucket.items)
+            if not joining:
+                if est_batch < self.burst_threshold:
+                    self.stats["oracle"] += 1
+                    return ORACLE, []
+                if not self._device_favored(est_batch, len(cps.policies)):
+                    # keep the dispatch-cost EMA honest without making any
+                    # request wait: occasionally send a fire-and-forget
+                    # shadow copy of this burst member to the device — in a
+                    # dedicated bucket, so no real request "joins" a probe
+                    # and blocks on a device the model just rejected
+                    if now - self._last_dispatch > self.probe_interval_s:
+                        self._last_dispatch = now
+                        self.stats["probe"] += 1
+                        pkey = key + ("probe",)
+                        b = self._buckets.get(pkey)
+                        if b is None:
+                            b = self._buckets[pkey] = _Bucket(cps)
+                        b.items.append((resource, Future()))
+                        self._lock.notify()
+                    self.stats["oracle"] += 1
+                    return ORACLE, []
+            self.stats["device"] += 1
             if bucket is None:
                 bucket = self._buckets[key] = _Bucket(cps)
             bucket.items.append((resource, fut))
             self._lock.notify()
+            # bound the wrong-way cost: if the dispatch estimate turns out
+            # optimistic, bail to the oracle after ~4x the expected RTT
+            # instead of eating the full deadline budget. Cold sets keep
+            # the full budget — their first flush legitimately pays XLA
+            # compilation
+            if self._seen_shapes.get(cps):
+                timeout_s = min(timeout_s,
+                                max(0.05, 4 * self._dispatch_cost
+                                    + self.window_s))
         try:
-            return fut.result(timeout=timeout_s)
+            status, row = fut.result(timeout=timeout_s)
         except Exception:
+            with self._lock:
+                self.stats["screen_timeout"] = (
+                    self.stats.get("screen_timeout", 0) + 1)
             return ATTENTION, []
+        with self._lock:
+            self.stats["clean" if status == CLEAN else "attention"] += 1
+        return status, row
 
     # ------------------------------------------------------------- worker
 
@@ -116,16 +315,34 @@ class AdmissionBatcher:
                 self._buckets = {k: b for k, b in self._buckets.items()
                                  if b.items}
             for cps, items in work:
-                self._flush(cps, items)
+                self._flush_pool.submit(self._flush, cps, items)
 
     def _flush(self, cps, items) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
+            from ..models.flatten import pad_to_buckets
+
             resources = [r for r, _ in items]
-            batch = cps.flatten(resources)
+            t0 = time.monotonic()
+            # bucket the batch shape so XLA compiles once per bucket, not
+            # once per distinct admission batch
+            batch, _ = pad_to_buckets(cps.flatten(resources))
+            shape_key = (batch.n, batch.e, int(batch.str_len.shape[0]))
             verdicts = np.asarray(cps.evaluate_device(batch))
+            dt = time.monotonic() - t0
+            with self._lock:
+                # a first-seen shape paid XLA compilation — that is a
+                # one-time cost, not the steady-state dispatch price
+                shapes = self._seen_shapes.setdefault(cps, set())
+                if shape_key in shapes:
+                    self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
+                else:
+                    shapes.add(shape_key)
+                self._batch_size_ema += 0.3 * (len(items)
+                                               - self._batch_size_ema)
+                self._last_dispatch = time.monotonic()
             for b, (_, fut) in enumerate(items):
                 row = []
                 clean = True
@@ -148,3 +365,4 @@ class AdmissionBatcher:
             self._stopped = True
             self._lock.notify()
         self._worker.join(timeout=2.0)
+        self._flush_pool.shutdown(wait=False)

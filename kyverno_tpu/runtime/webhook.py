@@ -103,6 +103,14 @@ class WebhookServer:
 
     def handle(self, path: str, review: dict) -> dict:
         """server.go:244 handlerFunc: the generic wrapper."""
+        if self.admission_batcher is not None:
+            # the in-flight count is the batcher's concurrency signal for
+            # oracle-vs-device routing (runtime/batch.py)
+            with self.admission_batcher.admission_in_flight():
+                return self._handle(path, review)
+        return self._handle(path, review)
+
+    def _handle(self, path: str, review: dict) -> dict:
         start = time.monotonic()
         self.last_request_time = time.time()
         request = review.get("request") or {}
@@ -329,16 +337,35 @@ class WebhookServer:
         # an all-green row admits without touching the CPU engine, anything
         # else drops to the oracle loop below for faithful messages
         screened_clean = False
+        screen_row: list = []
         if enforce and self.admission_batcher is not None:
             status, row = self.admission_batcher.screen(
                 PolicyType.VALIDATE_ENFORCE, kind, namespace, resource)
             if status == batch_mod.CLEAN:
                 screened_clean = True
                 self._record_screen_results(row, resource, kind, request)
+                self.admission_batcher.note_screen_savings(1.0)
+            elif status == batch_mod.ATTENTION and row:
+                screen_row = row
 
         if enforce and not screened_clean:
+            # rule-level hybrid merge: policies the device already cleared
+            # are recorded from the screen row; only policies with a
+            # FAIL/ERROR/HOST cell pay the CPU oracle (for faithful
+            # messages and context-dependent semantics)
+            run_policies = enforce
+            if screen_row:
+                from ..models import Verdict
+
+                bad = {p for p, _, v in screen_row
+                       if v not in (Verdict.PASS, Verdict.SKIP)}
+                self._record_screen_results(
+                    [t for t in screen_row if t[0] not in bad],
+                    resource, kind, request)
+                run_policies = [p for p in enforce if p.name in bad]
+            oracle_t0 = time.monotonic()
             pctx = self._policy_context(request, resource)
-            for policy in enforce:
+            for policy in run_policies:
                 pctx.policy = policy
                 resp = engine_validate(pctx)
                 for rule in resp.policy_response.rules:
@@ -354,6 +381,16 @@ class WebhookServer:
                     self.event_gen.add(*events_for_engine_response(resp))
                 if self.report_gen is not None:
                     self.report_gen.add(resp)
+            if self.admission_batcher is not None and run_policies:
+                # feed the router's cost model with the measured CPU price
+                # of this admission: full runs calibrate the per-policy
+                # EMA, hybrid runs calibrate the screen's time savings
+                dt = time.monotonic() - oracle_t0
+                if screen_row:
+                    self.admission_batcher.note_hybrid_cost(dt, len(enforce))
+                else:
+                    self.admission_batcher.note_oracle_cost(
+                        dt, len(run_policies))
 
         # a blocked request is returned BEFORE audit/generate side effects
         # (server.go:553-563)
@@ -491,40 +528,50 @@ class WebhookServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: the API server reuses webhook
+            # connections; Content-Length is mandatory for reuse, and
+            # Nagle must be off or header/body writes stall 40ms against
+            # the peer's delayed ACK
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):
                 pass
 
+            def _reply(self, code: int, body: bytes, ctype: str = ""):
+                self.send_response(code)
+                if ctype:
+                    self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path in (LIVENESS_PATH, READINESS_PATH):
-                    self.send_response(200)
-                    self.end_headers()
-                    self.wfile.write(b"ok")
+                    self._reply(200, b"ok")
                 elif self.path == "/metrics":
-                    body = server.registry.expose().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; version=0.0.4")
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(200, server.registry.expose().encode(),
+                                "text/plain; version=0.0.4")
                 else:
-                    self.send_response(404)
-                    self.end_headers()
+                    self._reply(404, b"")
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 try:
                     review = json.loads(self.rfile.read(length) or b"{}")
                     out = server.handle(self.path, review)
-                    body = json.dumps(out).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply(200, json.dumps(out).encode(),
+                                "application/json")
                 except Exception as e:
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(str(e).encode())
+                    self._reply(500, str(e).encode())
 
-        httpd = ThreadingHTTPServer((host, port), Handler)
+        class Httpd(ThreadingHTTPServer):
+            daemon_threads = True
+            # a burst of admissions must not overflow the accept backlog
+            # (the default of 5 turns SYN drops into 1s retransmit spikes)
+            request_queue_size = 128
+
+        httpd = Httpd((host, port), Handler)
         httpd.timeout = 15  # server.go:237 read/write timeouts
         if certfile and keyfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)

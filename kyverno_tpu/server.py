@@ -13,7 +13,6 @@ Run: ``python -m kyverno_tpu.server`` (in-cluster) or construct
 from __future__ import annotations
 
 import logging
-import os
 import signal
 import threading
 import time
@@ -40,6 +39,16 @@ from .runtime.webhookconfig import (
 )
 
 BACKGROUND_SCAN_INTERVAL_S = 3600.0  # cmd/kyverno/main.go:94 default 1h
+
+# representative resource for pre-compiling the admission screen kernel
+_WARMUP_POD = {
+    "apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "warmup", "namespace": "default",
+                 "labels": {"app": "warmup"}},
+    "spec": {"containers": [{"name": "c", "image": "registry.local/a:v1",
+                             "resources": {"requests": {"cpu": "100m"},
+                                           "limits": {"memory": "128Mi"}}}]},
+}
 
 
 def init_cleanup(client: Client) -> None:
@@ -81,12 +90,11 @@ class Controller:
         self.event_gen = EventGenerator(self.client)
         self.report_gen = ReportGenerator(self.client)
         self.cert_renewer = CertRenewer(self.client) if enable_tls else None
-        # the TPU device screen for enforce admissions (runtime/batch.py);
-        # opt-in: it trades a micro-batch window of latency for device
-        # throughput, the right call when the chip is local to the host
-        self.admission_batcher = (
-            AdmissionBatcher(self.policy_cache)
-            if os.environ.get("KTPU_ADMISSION_BATCH") == "1" else None)
+        # the TPU device screen for enforce admissions (runtime/batch.py),
+        # on by default: its latency router sends lone requests straight
+        # to the CPU oracle and engages the device only when a burst
+        # forms, so single-request latency never pays the device RTT
+        self.admission_batcher = AdmissionBatcher(self.policy_cache)
         self.webhook = WebhookServer(
             policy_cache=self.policy_cache, config=self.config,
             client=self.client, event_gen=self.event_gen,
@@ -103,6 +111,7 @@ class Controller:
             on_started_leading=self._start_leader_tasks,
         )
         self._scan_thread: threading.Thread | None = None
+        self._warm_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._scan_kick = threading.Event()
         self._loading_policies = False      # coalesce startup sync
@@ -132,9 +141,23 @@ class Controller:
                 "webhook config sync failed; will retry")
             self._webhook_sync_pending = True
 
+    def _warm_screen(self) -> None:
+        """Pre-compile the admission screen kernel off the hot path so the
+        first burst after a policy change never pays XLA compilation."""
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            return
+        from .runtime.policycache import PolicyType
+
+        self._warm_thread = threading.Thread(
+            target=lambda: self.admission_batcher.warmup(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default", _WARMUP_POD),
+            name="screen-warmup", daemon=True)
+        self._warm_thread.start()
+
     def _on_policy_change(self, event: str, policy) -> None:
         if not self._loading_policies:
             self._sync_webhooks()
+            self._warm_screen()
         if event == "DELETE":
             self.report_gen.prune_policy(policy.name)
             self.generate_controller.policies.pop(policy.name, None)
@@ -177,6 +200,7 @@ class Controller:
             self._loading_policies = False
         self.generate_controller.policies = policies
         self._sync_webhooks()
+        self._warm_screen()
 
     def sync_config(self) -> None:
         cm = self.client.get_configmap(self.namespace, "kyverno")

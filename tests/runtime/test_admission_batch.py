@@ -38,10 +38,16 @@ def review(resource):
                         "object": resource}}
 
 
-def make_batcher():
+def make_batcher(burst_threshold=1, **kw):
+    # burst_threshold=1 + a cost model that always favors the device:
+    # forces the screen lane so single-request tests exercise it; router
+    # behavior is tested separately below
+    kw.setdefault("dispatch_cost_init_s", 0.0)
+    kw.setdefault("oracle_cost_init_s", 1.0)
     cache = PolicyCache()
     cache.add(load_policy(ENFORCE))
-    return AdmissionBatcher(cache, window_s=0.002), cache
+    return AdmissionBatcher(cache, window_s=0.002,
+                            burst_threshold=burst_threshold, **kw), cache
 
 
 class TestBatcher:
@@ -70,7 +76,10 @@ class TestBatcher:
             batcher.stop()
 
     def test_no_policies_is_clean(self):
-        batcher = AdmissionBatcher(PolicyCache(), window_s=0.001)
+        batcher = AdmissionBatcher(PolicyCache(), window_s=0.001,
+                                   burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0)
         try:
             status, row = batcher.screen(
                 PolicyType.VALIDATE_ENFORCE, "Pod", "default",
@@ -114,11 +123,138 @@ class TestBatcher:
             batcher.stop()
 
 
+class TestLatencyRouter:
+    """Low arrival rate -> ORACLE immediately; a burst -> device lane."""
+
+    def test_lone_request_routes_to_oracle(self):
+        from kyverno_tpu.runtime.batch import ORACLE
+
+        batcher, cache = make_batcher(burst_threshold=4)
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        evals = []
+        orig = cps.evaluate_device
+        cps.evaluate_device = lambda b: (evals.append(b.n), orig(b))[1]
+        try:
+            status, row = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:1.21"))
+            assert status == ORACLE
+            assert row == []
+            assert evals == []          # the device was never touched
+            assert batcher.stats["oracle"] == 1
+        finally:
+            batcher.stop()
+
+    def test_burst_routes_to_device(self):
+        batcher, cache = make_batcher(burst_threshold=4)
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        evals = []
+        orig = cps.evaluate_device
+        cps.evaluate_device = lambda b: (evals.append(b.n), orig(b))[1]
+        try:
+            results = [None] * 16
+            barrier = threading.Barrier(16)
+
+            def worker(i):
+                barrier.wait()
+                results[i] = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                    pod("nginx:1.21", name=f"p{i}"))
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # the first arrivals below the threshold go oracle; once the
+            # rate estimator sees the burst, the rest share device batches
+            assert batcher.stats["device"] > 0
+            assert batcher.stats["device"] + batcher.stats["oracle"] == 16
+            # batches are bucket-padded, so eval rows >= routed items
+            assert sum(evals) >= batcher.stats["device"]
+            assert all(s in (CLEAN, "oracle") for s, _ in results)
+        finally:
+            batcher.stop()
+
+    def test_straggler_joins_forming_batch(self):
+        batcher, cache = make_batcher(burst_threshold=100)  # rate never trips
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        key = (int(PolicyType.VALIDATE_ENFORCE), "Pod", "default", id(cps))
+        from concurrent.futures import Future
+        from kyverno_tpu.runtime.batch import _Bucket
+
+        try:
+            # simulate a batch already forming for this bucket
+            with batcher._lock:
+                bucket = batcher._buckets[key] = _Bucket(cps)
+                bucket.items.append((pod("nginx:1.21", "seed"), Future()))
+                batcher._lock.notify()
+            status, _ = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:1.21", "straggler"))
+            assert status == CLEAN  # joined the device batch, not oracle
+        finally:
+            batcher.stop()
+
+
+class TestCostModel:
+    def test_expensive_device_routes_oracle_and_probes(self):
+        import time as _t
+
+        batcher, _ = make_batcher(
+            burst_threshold=1, dispatch_cost_init_s=10.0,
+            oracle_cost_init_s=0.001, probe_interval_s=0.0)
+        try:
+            t0 = _t.perf_counter()
+            status, row = batcher.screen(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                pod("nginx:1.21"))
+            elapsed = _t.perf_counter() - t0
+            from kyverno_tpu.runtime.batch import ORACLE
+
+            assert status == ORACLE and row == []
+            # the shadow probe fired but never blocked the request
+            assert batcher.stats["probe"] == 1
+            assert elapsed < 1.0
+            deadline = _t.monotonic() + 10
+            while not batcher._seen_shapes and _t.monotonic() < deadline:
+                _t.sleep(0.01)
+            assert batcher._seen_shapes  # the shadow flush really ran
+        finally:
+            batcher.stop()
+
+    def test_flush_updates_dispatch_cost_ema(self):
+        batcher, _ = make_batcher(burst_threshold=1)
+        try:
+            # first screen: compile flush (EMA untouched), second: measured
+            for _ in range(2):
+                batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                               "default", pod("nginx:1.21"))
+            assert batcher._dispatch_cost != 0.0  # EMA moved off the init
+        finally:
+            batcher.stop()
+
+    def test_pad_to_buckets_verdict_parity(self):
+        from kyverno_tpu.models import CompiledPolicySet
+        from kyverno_tpu.models.flatten import pad_to_buckets
+
+        cps = CompiledPolicySet([load_policy(ENFORCE)])
+        resources = [pod("nginx:latest"), pod("nginx:1.21"), pod("a:b")]
+        batch = cps.flatten(resources)
+        padded, n = pad_to_buckets(batch)
+        assert n == 3 and padded.n == 4
+        v1 = cps.evaluate_device(batch)
+        v2 = cps.evaluate_device(padded)
+        assert (v1 == v2[:3]).all()
+
+
 class TestWebhookScreenPath:
-    def make_server(self):
+    def make_server(self, burst_threshold=1):
         cache = PolicyCache()
         cache.add(load_policy(ENFORCE))
-        batcher = AdmissionBatcher(cache, window_s=0.002)
+        batcher = AdmissionBatcher(cache, window_s=0.002,
+                                   burst_threshold=burst_threshold)
         server = WebhookServer(policy_cache=cache, client=FakeCluster(),
                                admission_batcher=batcher)
         return server, batcher
@@ -143,5 +279,70 @@ class TestWebhookScreenPath:
             # faithful message comes from the oracle lane
             assert "latest tag not allowed" in (
                 out["response"]["status"]["message"])
+        finally:
+            batcher.stop()
+
+    def test_hybrid_merge_runs_oracle_only_for_bad_policies(self):
+        # two enforce policies: one passes on device, one fails — the
+        # oracle must re-run only the failing one, and the passing one's
+        # result must come from the screen row
+        import kyverno_tpu.runtime.webhook as webhook_mod
+
+        second = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "require-name"},
+            "spec": {
+                "validationFailureAction": "enforce",
+                "rules": [{
+                    "name": "has-name",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": "name required",
+                                 "pattern": {"metadata": {"name": "?*"}}},
+                }],
+            },
+        }
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        cache.add(load_policy(second))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        ran = []
+        orig_validate = webhook_mod.engine_validate
+
+        def counting(pctx):
+            ran.append(pctx.policy.name)
+            return orig_validate(pctx)
+
+        webhook_mod.engine_validate = counting
+        try:
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:latest")))
+            assert out["response"]["allowed"] is False
+            assert "latest tag not allowed" in (
+                out["response"]["status"]["message"])
+            # only the failing policy hit the oracle; require-name was
+            # cleared by the device screen
+            assert ran == ["disallow-latest-tag"]
+            # ...and its PASS was still recorded
+            assert "require-name" in server.registry.expose()
+        finally:
+            webhook_mod.engine_validate = orig_validate
+            batcher.stop()
+
+    def test_oracle_routed_admission_still_correct(self):
+        # production default: lone requests route to the CPU oracle; both
+        # verdicts must be identical to the screened path
+        server, batcher = self.make_server(burst_threshold=4)
+        try:
+            ok = server.handle(VALIDATING_WEBHOOK_PATH,
+                               review(pod("nginx:1.21")))
+            bad = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:latest")))
+            assert ok["response"]["allowed"] is True
+            assert bad["response"]["allowed"] is False
+            assert batcher.stats["oracle"] >= 2
         finally:
             batcher.stop()
