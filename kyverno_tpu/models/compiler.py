@@ -424,6 +424,13 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
                 rule_kinds[rule.rule_index, j] = kind_id(
                     _title_first(k.split("/")[-1]))
 
+    if not paths:
+        # a rule set whose device lane is pure gates (kind-only match, no
+        # pattern paths — e.g. a mutate-gate screen) still needs a
+        # non-empty path axis for the kernel's gathers; the sentinel is
+        # never referenced by any check
+        paths.append("metadata")
+
     if nfa_rows:
         nfa_char = np.stack([r[0] for r in nfa_rows])
         nfa_star = np.stack([r[1] for r in nfa_rows])

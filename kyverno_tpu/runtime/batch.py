@@ -75,7 +75,8 @@ class AdmissionBatcher:
                  rate_window_s: float = 0.05,
                  oracle_cost_init_s: float = 0.002,
                  dispatch_cost_init_s: float = 0.150,
-                 probe_interval_s: float = 10.0):
+                 probe_interval_s: float = 10.0,
+                 cold_flush_fallback: bool = True):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
@@ -85,6 +86,10 @@ class AdmissionBatcher:
         self.burst_threshold = burst_threshold
         self.rate_window_s = rate_window_s
         self.probe_interval_s = probe_interval_s
+        # release waiters to the oracle when a flush must compile a new
+        # shape bucket (tests that assert on first-flush verdicts turn
+        # this off)
+        self.cold_flush_fallback = cold_flush_fallback
         # cost model (seconds), self-calibrating: dispatch starts
         # pessimistic so a remote/tunneled chip is never trusted until a
         # shadow probe has actually measured it; oracle cost is tracked
@@ -305,8 +310,9 @@ class AdmissionBatcher:
             # micro-batch window: let concurrent requests pile in
             time.sleep(self.window_s)
             with self._lock:
-                work = [(b.cps, b.items[:self.max_batch])
-                        for b in self._buckets.values() if b.items]
+                work = [(b.cps, b.items[:self.max_batch],
+                         k and k[-1] == "probe")
+                        for k, b in self._buckets.items() if b.items]
                 for b in self._buckets.values():
                     del b.items[:self.max_batch]
                 # drained buckets go away: bucket keys embed id(cps), so a
@@ -314,10 +320,10 @@ class AdmissionBatcher:
                 # old CompiledPolicySet forever
                 self._buckets = {k: b for k, b in self._buckets.items()
                                  if b.items}
-            for cps, items in work:
-                self._flush_pool.submit(self._flush, cps, items)
+            for cps, items, is_probe in work:
+                self._flush_pool.submit(self._flush, cps, items, is_probe)
 
-    def _flush(self, cps, items) -> None:
+    def _flush(self, cps, items, is_probe: bool = False) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
@@ -330,18 +336,35 @@ class AdmissionBatcher:
             # once per distinct admission batch
             batch, _ = pad_to_buckets(cps.flatten(resources))
             shape_key = (batch.n, batch.e, int(batch.str_len.shape[0]))
+            with self._lock:
+                cold = shape_key not in self._seen_shapes.setdefault(cps,
+                                                                     set())
+            if cold and self.cold_flush_fallback and not is_probe:
+                # this flush is about to pay XLA compilation — release the
+                # waiters to the oracle now and let the compile warm the
+                # bucket in the background for the next burst
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_result((ATTENTION, []))
             verdicts = np.asarray(cps.evaluate_device(batch))
             dt = time.monotonic() - t0
             with self._lock:
-                # a first-seen shape paid XLA compilation — that is a
-                # one-time cost, not the steady-state dispatch price
-                shapes = self._seen_shapes.setdefault(cps, set())
-                if shape_key in shapes:
+                # a cold-entry flush paid (or was blocked behind) XLA
+                # compilation — a one-time cost, not the steady-state
+                # dispatch price. The flag captured BEFORE eval governs:
+                # a concurrent flush of the same shape that raced the
+                # compile must not feed its compile-blocked dt to the EMA
+                # either, even though the shape is in the set by now
+                if not cold:
                     self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
                 else:
-                    shapes.add(shape_key)
-                self._batch_size_ema += 0.3 * (len(items)
-                                               - self._batch_size_ema)
+                    self._seen_shapes[cps].add(shape_key)
+                if not is_probe:
+                    # probes are batches of one by construction — feeding
+                    # them to the realized-batch EMA would drag it to 1
+                    # and lock the device lane out permanently
+                    self._batch_size_ema += 0.3 * (len(items)
+                                                   - self._batch_size_ema)
                 self._last_dispatch = time.monotonic()
             for b, (_, fut) in enumerate(items):
                 row = []

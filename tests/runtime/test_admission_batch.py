@@ -44,6 +44,7 @@ def make_batcher(burst_threshold=1, **kw):
     # behavior is tested separately below
     kw.setdefault("dispatch_cost_init_s", 0.0)
     kw.setdefault("oracle_cost_init_s", 1.0)
+    kw.setdefault("cold_flush_fallback", False)
     cache = PolicyCache()
     cache.add(load_policy(ENFORCE))
     return AdmissionBatcher(cache, window_s=0.002,
@@ -79,7 +80,8 @@ class TestBatcher:
         batcher = AdmissionBatcher(PolicyCache(), window_s=0.001,
                                    burst_threshold=1,
                                    dispatch_cost_init_s=0.0,
-                                   oracle_cost_init_s=1.0)
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False)
         try:
             status, row = batcher.screen(
                 PolicyType.VALIDATE_ENFORCE, "Pod", "default",
@@ -250,11 +252,17 @@ class TestCostModel:
 
 
 class TestWebhookScreenPath:
-    def make_server(self, burst_threshold=1):
+    def make_server(self, burst_threshold=1, **kw):
+        # same cost-model forcing as make_batcher: without it the router
+        # would send every test admission to the oracle and the screened
+        # paths (_record_screen_results, hybrid merge) would lose coverage
+        kw.setdefault("dispatch_cost_init_s", 0.0)
+        kw.setdefault("oracle_cost_init_s", 1.0)
+        kw.setdefault("cold_flush_fallback", False)
         cache = PolicyCache()
         cache.add(load_policy(ENFORCE))
         batcher = AdmissionBatcher(cache, window_s=0.002,
-                                   burst_threshold=burst_threshold)
+                                   burst_threshold=burst_threshold, **kw)
         server = WebhookServer(policy_cache=cache, client=FakeCluster(),
                                admission_batcher=batcher)
         return server, batcher
@@ -306,7 +314,8 @@ class TestWebhookScreenPath:
         cache.add(load_policy(second))
         batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
                                    dispatch_cost_init_s=0.0,
-                                   oracle_cost_init_s=1.0)
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False)
         server = WebhookServer(policy_cache=cache, client=FakeCluster(),
                                admission_batcher=batcher)
         ran = []

@@ -1,0 +1,292 @@
+"""Batch mutate tier: byte-parity with the serial engine path.
+
+The contract (engine/mutate/batch.py): for any policy set and document
+list, ``BatchMutator.apply`` produces exactly the patches and patched
+resources the serial per-policy engine chain produces — with or without
+the device gate screen.
+"""
+
+import json
+import random
+
+from kyverno_tpu.api.load import load_policies_from_path, load_policy
+from kyverno_tpu.engine.context import Context
+from kyverno_tpu.engine.mutate.batch import (
+    BatchMutator,
+    fast_strategic_merge,
+    merge_emit,
+)
+from kyverno_tpu.engine.mutate.json_patch import generate_patches
+from kyverno_tpu.engine.mutate.strategic_merge import (
+    ConditionError,
+    GlobalConditionError,
+    _has_anchor,
+    _has_anchors,
+    merge,
+    pre_process_pattern,
+    strategic_merge_patch,
+)
+from kyverno_tpu.engine.mutation import mutate
+from kyverno_tpu.engine.policy_context import PolicyContext
+from kyverno_tpu.utils.jsoncopy import json_copy
+
+
+def serial_reference(policies, doc):
+    """The webhook's serial chain (runtime/webhook.py _resource_mutation):
+    per policy, engine mutate; patched resource feeds the next policy."""
+    resource = doc
+    patches = []
+    for policy in policies:
+        jctx = Context()
+        jctx.add_resource(resource)
+        resp = mutate(PolicyContext(policy=policy, new_resource=resource,
+                                    json_context=jctx))
+        patches.extend(resp.patches)
+        if resp.patched_resource is not None:
+            resource = resp.patched_resource
+    return patches, resource
+
+
+def assert_parity(policies, docs, **apply_kw):
+    batch = BatchMutator(policies)
+    results = batch.apply(docs, **apply_kw)
+    for doc, got in zip(docs, results):
+        want_patches, want_resource = serial_reference(policies, doc)
+        assert json.dumps(got.patches) == json.dumps(want_patches), (
+            f"patch divergence for {doc}\n"
+            f"batch={got.patches}\nserial={want_patches}")
+        assert got.patched_resource == want_resource
+
+
+def pod(i, kind="Pod", labels=None):
+    doc = {"apiVersion": "v1", "kind": kind,
+           "metadata": {"name": f"r-{i}", "namespace": "default"},
+           "spec": {"containers": [{"name": "c", "image": f"img:{i}"}]}}
+    if labels:
+        doc["metadata"]["labels"] = labels
+    return doc
+
+
+class TestReferenceCorpus:
+    def test_add_default_labels_mixed_kinds(self):
+        pols = [p for p in load_policies_from_path("/root/reference/test/more/")
+                if p.name == "add-default-labels"]
+        docs = [pod(0), pod(1, kind="Service"), pod(2, kind="Namespace"),
+                pod(3, kind="Deployment"),  # not matched by the policy
+                pod(4, labels={"custom-foo-label": "already-set"})]
+        assert_parity(pols, docs, use_device_gate=False)
+        assert_parity(pols, docs, use_device_gate=True)
+
+    def test_whole_mutate_corpus(self):
+        pols = [p for p in load_policies_from_path("/root/reference/test/more/")
+                if any(r.has_mutate() for r in p.spec.rules)]
+        assert pols, "corpus should contain mutate policies"
+        docs = [pod(i) for i in range(8)]
+        assert_parity(pols, docs, use_device_gate=False)
+        assert_parity(pols, docs, use_device_gate=True)
+
+    def test_gate_skips_unmatched_kinds(self):
+        pols = [p for p in load_policies_from_path("/root/reference/test/more/")
+                if p.name == "add-default-labels"]
+        batch = BatchMutator(pols)
+        docs = [pod(i, kind="Secret") for i in range(4)]
+        for r in batch.apply(docs, use_device_gate=True):
+            assert r.patches == []
+
+
+class TestChaining:
+    POLICIES = [
+        {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "step1"},
+            "spec": {"rules": [{
+                "name": "tag",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "metadata": {"labels": {"stage": "tagged"}}}},
+            }]},
+        },
+        {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "step2"},
+            "spec": {"rules": [{
+                "name": "after-tag",
+                # matches only resources rule 1 just labeled: the batch
+                # tier must re-gate on the patched doc, not the original
+                "match": {"resources": {"kinds": ["Pod"], "selector": {
+                    "matchLabels": {"stage": "tagged"}}}},
+                "mutate": {"patchStrategicMerge": {
+                    "metadata": {"annotations": {"+(chained)": "yes"}}}},
+            }]},
+        },
+    ]
+
+    def test_patch_enables_later_rule(self):
+        policies = [load_policy(p) for p in self.POLICIES]
+        docs = [pod(i) for i in range(4)]
+        assert_parity(policies, docs, use_device_gate=False)
+        assert_parity(policies, docs, use_device_gate=True)
+        # and the chain really fired: both labels and annotation landed
+        got = BatchMutator(policies).apply(docs, use_device_gate=True)[0]
+        assert got.patched_resource["metadata"]["labels"]["stage"] == "tagged"
+        assert got.patched_resource["metadata"]["annotations"]["chained"] == "yes"
+
+
+class TestMixedPlan:
+    def test_engine_fallback_policy_does_not_shift_gate_columns(self):
+        # policy A mixes a static rule with a variable rule -> whole policy
+        # falls back to the engine and must NOT consume gate columns;
+        # policy B's single gate must land on column 0
+        mixed = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "mixed"},
+            "spec": {"rules": [
+                {"name": "static", "match": {"resources": {"kinds": ["Pod"]}},
+                 "mutate": {"patchStrategicMerge": {
+                     "metadata": {"labels": {"s": "1"}}}}},
+                {"name": "vars", "match": {"resources": {"kinds": ["Pod"]}},
+                 "mutate": {"patchStrategicMerge": {
+                     "metadata": {"labels": {"n": "{{request.object.metadata.name}}"}}}}},
+            ]},
+        })
+        fast = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "fast"},
+            "spec": {"rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "metadata": {"labels": {"f": "1"}}}}}]},
+        })
+        bm = BatchMutator([mixed, fast])
+        modes = [(p.name, mode) for p, mode, _ in bm.plan]
+        assert ("mixed", "engine") in modes and ("fast", "fast") in modes
+        (_, _, fast_rules), = [t for t in bm.plan if t[0].name == "fast"]
+        assert fast_rules[0].gate_index == 0
+        docs = [pod(i) for i in range(4)]
+        assert_parity([mixed, fast], docs, use_device_gate=True)
+
+    def test_kind_only_gate_compiles_on_device(self):
+        # a gate with no pattern paths at all (kind-only match) must still
+        # evaluate on device, not silently fall back to host gating
+        pols = [load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "kind-only"},
+            "spec": {"rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "metadata": {"labels": {"k": "1"}}}}}]},
+        })]
+        bm = BatchMutator(pols)
+        verdicts = bm.gate_verdicts([pod(0), pod(1, kind="Secret")])
+        assert verdicts is not None, "device gate must not silently degrade"
+        assert verdicts[0, 0] == 1 and verdicts[1, 0] == 0  # PASS / NA
+
+
+KEYS = ["alpha", "beta", "labels", "mode", "name"]
+VALS = ["on", "off", "3", "250m", "", True, 7, None]
+
+
+def rand_tree(rng, depth=0):
+    r = rng.random()
+    if depth >= 3 or r < 0.4:
+        return rng.choice(VALS)
+    if r < 0.55:
+        return [rand_tree(rng, depth + 2) for _ in range(rng.randint(0, 3))]
+    return {rng.choice(KEYS): rand_tree(rng, depth + 1)
+            for _ in range(rng.randint(0, 3))}
+
+
+def rand_overlay(rng, depth=0):
+    """Overlay grammar: maps with plain, +(add), (condition) keys, keyed
+    and plain lists, scalars — the anchor families strategic merge
+    understands."""
+    r = rng.random()
+    if depth >= 3 or r < 0.35:
+        return rng.choice(VALS)
+    if r < 0.5:
+        els = []
+        for _ in range(rng.randint(1, 2)):
+            el = {"name": rng.choice(["a", "b", "c"])}
+            el[rng.choice(KEYS[:4])] = rand_overlay(rng, depth + 2)
+            els.append(el)
+        return els
+    out = {}
+    for key in rng.sample(KEYS[:4], rng.randint(1, 3)):
+        kind = rng.random()
+        if kind < 0.25:
+            out[f"+({key})"] = rand_overlay(rng, depth + 1)
+        elif kind < 0.45:
+            out[f"({key})"] = rng.choice(["on", "off", "3", "?*"])
+        else:
+            out[key] = rand_overlay(rng, depth + 1)
+    return out
+
+
+class TestMergeEmitProperty:
+    def test_merge_emit_matches_merge_plus_diff(self):
+        rng = random.Random(2024)
+        for _ in range(400):
+            base = rand_tree(rng)
+            patch = rand_overlay(rng)
+            if not isinstance(base, dict) or not isinstance(patch, dict):
+                continue
+            # strip anchors for the raw-merge comparison
+            patch = json.loads(json.dumps(patch).replace("+(", "").replace(
+                ")\":", "\":").replace("(", "").replace(")", ""))
+            want_merged = merge(patch, base)
+            want_ops = generate_patches(base, want_merged)
+            ops: list = []
+            got_merged = merge_emit(patch, json_copy(base), "", ops)
+            from kyverno_tpu.engine.mutate.json_patch import (
+                filter_and_sort_patches,
+            )
+
+            assert got_merged == want_merged, (base, patch)
+            assert json.dumps(filter_and_sort_patches(ops)) == json.dumps(
+                want_ops), (base, patch, ops, want_ops)
+
+    def test_fast_strategic_merge_matches_engine_pipeline(self):
+        rng = random.Random(777)
+        for _ in range(400):
+            base = rand_tree(rng)
+            overlay = rand_overlay(rng)
+            if not isinstance(base, dict) or not isinstance(overlay, dict):
+                continue
+            try:
+                want_patched = strategic_merge_patch(base, overlay)
+            except Exception:
+                continue
+            want_ops = generate_patches(base, want_patched)
+            got_patched, got_ops = fast_strategic_merge(
+                json_copy(base), overlay,
+                _has_anchors(overlay, _has_anchor))
+            assert json.dumps(got_ops) == json.dumps(want_ops), (
+                base, overlay, got_ops, want_ops)
+            # on condition failure the fast path returns base unpatched
+            # (same bytes as the engine's copy)
+            assert got_patched == want_patched, (base, overlay)
+
+
+class TestPolicyFuzzParity:
+    def test_fuzzed_policies_full_parity(self):
+        rng = random.Random(4242)
+        for i in range(40):
+            overlay = rand_overlay(rng)
+            if not isinstance(overlay, dict) or not overlay:
+                continue
+            policy = load_policy({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": f"fz-{i}"},
+                "spec": {"rules": [{
+                    "name": f"fz-{i}-r",
+                    "match": {"resources": {"kinds": ["ConfigMap"]}},
+                    "mutate": {"patchStrategicMerge": {"data": overlay}},
+                }]},
+            })
+            docs = []
+            for j in range(5):
+                t = rand_tree(rng)
+                docs.append({"apiVersion": "v1", "kind": "ConfigMap",
+                             "metadata": {"name": f"cm-{j}"},
+                             "data": t if isinstance(t, dict) else {"k": t}})
+            assert_parity([policy], docs, use_device_gate=False)
