@@ -295,20 +295,21 @@ def bench_config2(jax):
     B = 4096
     resources = [make_pod(i) for i in range(B)]
 
-    cps.flatten(resources[:8])  # warm the native flattener
+    cps.flatten_packed(resources[:8])  # warm the native flattener
     t0 = time.monotonic()
-    batch = cps.flatten(resources)
+    batch = cps.flatten_packed(resources)
+    blob, shp = batch.packed_blob()
     flatten_s = time.monotonic() - t0
 
-    fn = cps.eval_fn
-    dargs = jax.device_put(batch.device_args())
-    jax.block_until_ready(dargs)
-    out = fn(*dargs)
+    fn = cps.blob_eval_fn
+    dblob = jax.device_put(blob)
+    dblob.block_until_ready()
+    out = fn(dblob, *shp)
     out.block_until_ready()  # compile + first run
 
     n_iters = 30
     t0 = time.monotonic()
-    outs = [fn(*dargs) for _ in range(n_iters)]
+    outs = [fn(dblob, *shp) for _ in range(n_iters)]
     jax.block_until_ready(outs)
     device_s = (time.monotonic() - t0) / n_iters
 
@@ -337,18 +338,20 @@ def bench_config3(jax):
     cps = CompiledPolicySet(_library_250())
     B = 10_000
     resources = [mixed_resource(i) for i in range(B)]
+    cps.flatten_packed(resources[:8])  # warm the native flattener
     t0 = time.monotonic()
-    batch = cps.flatten(resources)
+    batch = cps.flatten_packed(resources)
+    blob, shp = batch.packed_blob()
     flatten_s = time.monotonic() - t0
 
-    fn = cps.eval_fn
-    dargs = jax.device_put(batch.device_args())
-    jax.block_until_ready(dargs)
-    out = fn(*dargs)
+    fn = cps.blob_eval_fn
+    dblob = jax.device_put(blob)
+    dblob.block_until_ready()
+    out = fn(dblob, *shp)
     out.block_until_ready()
     n_iters = 5
     t0 = time.monotonic()
-    outs = [fn(*dargs) for _ in range(n_iters)]
+    outs = [fn(dblob, *shp) for _ in range(n_iters)]
     jax.block_until_ready(outs)
     device_s = (time.monotonic() - t0) / n_iters
 
@@ -458,42 +461,51 @@ def bench_config4(jax):
 
 def bench_config5(jax):
     """Background-scan replay: 1M-resource snapshot through the full
-    pipeline — chunked parallel native flatten (ctypes releases the GIL)
-    feeding pipelined device dispatch."""
+    pipeline — native flatten of chunk N+1 overlapping the single-blob
+    transfer + device eval of chunk N, with per-rule counts reduced on
+    device (readback is bytes, not the [B, R] verdict matrix)."""
     from kyverno_tpu.api.load import load_policies_from_path
     from kyverno_tpu.models import CompiledPolicySet
+    from kyverno_tpu.ops.eval import build_scan_fn_blob
 
     cps = CompiledPolicySet(
         load_policies_from_path("/root/reference/test/best_practices/"))
-    fn = cps.eval_fn
     n_rules = int(cps.tensors.n_rules)
+    scan_fn = build_scan_fn_blob(cps.tensors)
 
     chunk = 65_536
     n_chunks = 16                      # 1,048,576 resources
     total = chunk * n_chunks
 
-    # snapshot synthesis is corpus setup, not scan work — untimed
-    snapshots = [[make_pod(c * chunk + j) for j in range(chunk)]
-                 for c in range(n_chunks)]
+    # snapshot synthesis is corpus setup, not scan work — untimed. The
+    # chunks are pre-serialized JSON arrays: a real background scan's
+    # input IS wire bytes (the apiserver list response), so the timed
+    # region starts where a deployment's would — at the byte stream.
+    snapshots = [
+        json.dumps([make_pod(c * chunk + j) for j in range(chunk)]).encode()
+        for c in range(n_chunks)
+    ]
+
+    def flatten_chunk(js: bytes):
+        return cps.flatten_packed(json_docs=js, n_docs=chunk).packed_blob()
 
     # warm: compile the kernel on a representative chunk shape
-    warm = cps.flatten(snapshots[0])
-    out = fn(*jax.device_put(warm.device_args()))
-    out.block_until_ready()
+    blob, shp = flatten_chunk(snapshots[0])
+    jax.block_until_ready(scan_fn(blob, *shp))
 
-    # the scan pipeline: worker threads flatten (the native flattener
-    # releases the GIL); the main thread streams finished batches onto the
-    # device, where dispatch pipelines with the transfers
+    # the scan pipeline: a worker thread flattens ahead (the native
+    # flattener parses the JSON bytes with the GIL released) while the
+    # main thread streams blobs onto the device; outputs stay on device
+    # until the end so readback latency amortizes across the whole scan
     t0 = time.monotonic()
     outs = []
-    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
-        for batch in ex.map(cps.flatten, snapshots):
-            outs.append(fn(*batch.device_args()))
-    from kyverno_tpu.models.engine import Verdict
-
+    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+        for blob, shp in ex.map(flatten_chunk, snapshots):
+            outs.append(scan_fn(blob, *shp))
     jax.block_until_ready(outs)
     dt = time.monotonic() - t0
-    fails = int(sum((np.array(o) == Verdict.FAIL).sum() for o in outs))
+    fails = int(sum(int(np.asarray(f).sum()) for f, _, _ in outs))
+    host_rows = int(sum(int(np.asarray(h).sum()) for _, _, h in outs))
     return {
         "resources": total,
         "chunk": chunk,
@@ -501,6 +513,7 @@ def bench_config5(jax):
         "scan_s": round(dt, 2),
         "e2e_rate": round(total * n_rules / dt),
         "fail_cells": fails,
+        "host_rows": host_rows,
     }
 
 

@@ -249,7 +249,7 @@ class BatchMutator:
         chunked so a large scan never ships one giant transfer. Chunks pad
         to power-of-two shape buckets so XLA compiles once per bucket, not
         once per chunk."""
-        from ...models.flatten import pad_to_buckets
+        from ...models.flatten import pad_to_buckets_packed
 
         if self._gate_cps is None:
             return None
@@ -257,9 +257,9 @@ class BatchMutator:
             outs = []
             for i in range(0, len(resources), chunk):
                 rs = resources[i:i + chunk]
-                batch, n0 = pad_to_buckets(self._gate_cps.flatten(rs))
-                v = np.asarray(self._gate_cps.eval_fn(
-                    *batch.device_args()))[:n0]
+                batch, n0 = pad_to_buckets_packed(
+                    self._gate_cps.flatten_packed(rs))
+                v = self._gate_cps.evaluate_device(batch)[:n0]
                 outs.append(self._gate_cps.resolve_host_cells(rs, v))
             return outs[0] if len(outs) == 1 else np.vstack(outs)
         except Exception:

@@ -63,6 +63,7 @@ class CompiledPolicySet:
         self.rule_irs = rule_irs
         self.tensors: PolicyTensors = compile_tensors(rule_irs)
         self._eval_fn = None
+        self._blob_eval_fn = None
         import threading
 
         self._eval_fn_lock = threading.Lock()
@@ -81,14 +82,43 @@ class CompiledPolicySet:
                     self._eval_fn = build_eval_fn(self.tensors)
         return self._eval_fn
 
+    @property
+    def blob_eval_fn(self):
+        """Single-transfer kernel fn(blob, B, P, E, V) — the hot path for
+        admission screening and background scans (one H2D round trip)."""
+        if self._blob_eval_fn is None:
+            with self._eval_fn_lock:
+                if self._blob_eval_fn is None:
+                    from ..ops.eval import build_eval_fn_blob
+
+                    self._blob_eval_fn = build_eval_fn_blob(self.tensors)
+        return self._blob_eval_fn
+
     def flatten(self, resources: list[dict]) -> FlatBatch:
         from .native_flatten import flatten_batch_fast
 
         return flatten_batch_fast(resources, self.tensors)
 
-    def evaluate_device(self, batch: FlatBatch) -> np.ndarray:
-        """Device verdicts [B, R] (host-lane rows = Verdict.HOST)."""
-        out = self.eval_fn(*batch.device_args())
+    def flatten_packed(self, resources: list[dict] | None = None,
+                       requests: list[dict] | None = None,
+                       json_docs: bytes | None = None,
+                       n_docs: int | None = None,
+                       json_reqs: bytes | None = None):
+        """PackedBatch — the transfer-thin flatten for device dispatch.
+        Pass ``json_docs`` (JSON array bytes, e.g. an apiserver list
+        response's items) to skip Python-side serialization entirely."""
+        from .native_flatten import flatten_packed_fast
+
+        return flatten_packed_fast(
+            self.tensors, resources, requests=requests,
+            json_docs=json_docs, n_docs=n_docs, json_reqs=json_reqs)
+
+    def evaluate_device(self, batch) -> np.ndarray:
+        """Device verdicts [B, R] (host-lane rows = Verdict.HOST).
+        Accepts a FlatBatch or PackedBatch; dispatches the single-blob
+        transfer form either way."""
+        blob, shp = batch.packed_blob()
+        out = self.blob_eval_fn(blob, *shp)
         return np.array(out)
 
     # ------------------------------------------------------------ full

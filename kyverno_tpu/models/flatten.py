@@ -51,6 +51,39 @@ BATCH_ARRAYS = (
 )
 DICT_ARRAYS = ("str_bytes", "str_len", "str_has_glob")
 
+# Packed transfer format. The 16 per-cell lanes compress into two uint32
+# words per cell, because every *value* lane (num/dur/bool) is a pure
+# function of the interned string: those move to a [V, 5] dictionary table
+# gathered back by str_id on device. The per-cell words:
+#   word0: str_id + 1                     (0 = no interned string)
+#   word1: mask(16) | type_tag(3)<<16 | slot_valid<<19 | null_break<<20
+#          | num_int<<21 | (elem0 + 1)<<22   (8 bits; > ELEM0_CAP -> host)
+# and one uint32 per resource:
+#   bmeta: (kind_id + 1)(16) | host_flag<<16 | live<<17
+# The dictionary value table [V, 5] uint32:
+#   d0: num_lo(31) | num_ok<<31        d1: num_hi (two's complement)
+#   d2: dur_lo(31) | dur_ok<<31        d3: dur_hi (two's complement)
+#   d4: str_len(7) | has_glob<<7 | bool_val<<8 | dur_any<<9 | num_plain<<10
+# Cutting the admission/scan H2D from ~35 bytes/cell over 19 arrays to
+# ~8 bytes/cell over 4 arrays is what makes the tunnel-attached TPU viable
+# for the 1M-resource background scan (BASELINE config 5).
+PACKED_BATCH_ARRAYS = ("cells", "bmeta")
+PACKED_DICT_ARRAYS = ("str_bytes", "dictv")
+ELEM0_CAP = 254  # largest representable first-element index
+
+
+def _assemble_blob(cells, bmeta, str_bytes, dictv):
+    """Concatenate the packed arrays into one uint32 transfer buffer.
+    ops.eval._split_blob is the device-side inverse."""
+    B, P, E = cells.shape[:3]
+    V = int(dictv.shape[0])
+    sw = np.ascontiguousarray(str_bytes).view(np.uint32)
+    blob = np.concatenate([
+        cells.reshape(-1), bmeta.reshape(-1),
+        dictv.reshape(-1), sw.reshape(-1),
+    ])
+    return blob, (B, P, E, V)
+
 
 @dataclass
 class FlatBatch:
@@ -92,6 +125,30 @@ class FlatBatch:
         """Canonical argument order for ops.eval.build_eval_fn output."""
         return tuple(getattr(self, k) for k in BATCH_ARRAYS + DICT_ARRAYS)
 
+    def packed_args(self) -> tuple:
+        """(cells, bmeta, str_bytes, dictv) for build_eval_fn_packed —
+        the transfer-thin form (see PACKED_BATCH_ARRAYS). Cached: admission
+        retries and the scan pipeline reuse the same FlatBatch."""
+        packed = getattr(self, "_packed", None)
+        if packed is None:
+            packed = pack_batch(self)
+            object.__setattr__(self, "_packed", packed)
+        return packed
+
+    def packed_blob(self) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+        """One contiguous uint32 buffer + (B, P, E, V) static shape for
+        build_eval_fn_blob. A single host->device transfer: the tunnel
+        that fronts remote TPU chips charges a fixed round-trip per array,
+        so 4 packed arrays cost ~4x the latency of their total bytes."""
+        blob = getattr(self, "_blob", None)
+        if blob is None:
+            blob = _assemble_blob(*self.packed_args())
+            object.__setattr__(self, "_blob", blob)
+        return blob
+
+    def to_flat(self) -> "FlatBatch":
+        return self
+
 
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
@@ -125,6 +182,215 @@ def pad_to_buckets(batch: FlatBatch) -> tuple["FlatBatch", int]:
         width = [(0, v2 - v)] + [(0, 0)] * (x.ndim - 1)
         updates[name] = np.pad(x, width, constant_values=0)
     return replace(batch, **updates), b
+
+
+def pack_batch(batch: FlatBatch) -> tuple:
+    """Compress a FlatBatch into the packed transfer form
+    (cells uint32 [B,P,E,2], bmeta uint32 [B], str_bytes, dictv uint32 [V,5]).
+
+    The dictionary value rows are scattered from the cell lanes rather than
+    re-analyzed from the strings: within one batch every cell referencing a
+    dictionary row agrees on that row's value lanes for its type class
+    (num lanes are shared by T_NUM/T_STR referents — a JSON number and the
+    equal string intern the same text and micro value; dur lanes are set
+    only by T_STR cells; bool only by T_BOOL), so last-write-wins is exact.
+    Rows referenced by no cell of a class leave that class's bits zero, and
+    the device unpack gates each class by type_tag, so the bits are never
+    read. Resources whose elem0 exceeds ELEM0_CAP take the host lane (the
+    oracle re-walks the original document, so capping is correct)."""
+    u32 = np.uint32
+    sid_w = (batch.str_id.astype(np.int64) + 1).astype(u32)
+    e0 = batch.elem0.astype(np.int64)
+    e0_over = e0 > ELEM0_CAP - 1
+    e0_w = np.minimum(e0 + 1, 255).astype(u32)
+    meta = (
+        batch.mask.astype(u32)
+        | (batch.type_tag.astype(u32) << 16)
+        | (batch.slot_valid.astype(u32) << 19)
+        | (batch.null_break.astype(u32) << 20)
+        | (batch.num_int.astype(u32) << 21)
+        | (e0_w << 22)
+    )
+    cells = np.stack([sid_w, meta], axis=-1)
+
+    # a numeric/duration value on a string too long to intern has no
+    # dictionary row to carry it — route the resource to the CPU oracle
+    # (mirrors ktpu_flatten_packed's long-text handling)
+    lost = ((batch.num_ok | batch.dur_any) & (batch.str_id < 0)).any(axis=(1, 2))
+    host = batch.host_flag | e0_over.any(axis=(1, 2)) | lost
+    bmeta = (
+        (batch.kind_id.astype(np.int64) + 1).astype(u32)
+        | (host.astype(u32) << 16)
+        | (batch.live.astype(u32) << 17)
+    )
+
+    V = int(batch.str_len.shape[0])
+    d = np.zeros((V, 5), dtype=u32)
+    sid = batch.str_id.ravel()
+    tag = batch.type_tag.ravel()
+    ref = sid >= 0
+
+    numsel = ref & ((tag == T_NUM) | (tag == T_STR))
+    i = sid[numsel]
+    d[i, 0] = (batch.num_lo.ravel()[numsel].astype(np.int64) & 0x7FFFFFFF).astype(u32) \
+        | (batch.num_ok.ravel()[numsel].astype(u32) << 31)
+    d[i, 1] = batch.num_hi.ravel()[numsel].astype(u32)
+    plain = np.zeros(V, dtype=u32)
+    plain[i] = batch.num_plain.ravel()[numsel].astype(u32)
+
+    dursel = ref & (tag == T_STR)
+    i = sid[dursel]
+    d[i, 2] = (batch.dur_lo.ravel()[dursel].astype(np.int64) & 0x7FFFFFFF).astype(u32) \
+        | (batch.dur_ok.ravel()[dursel].astype(u32) << 31)
+    d[i, 3] = batch.dur_hi.ravel()[dursel].astype(u32)
+    durany = np.zeros(V, dtype=u32)
+    durany[i] = batch.dur_any.ravel()[dursel].astype(u32)
+
+    boolv = np.zeros(V, dtype=u32)
+    boolsel = ref & (tag == T_BOOL)
+    i = sid[boolsel]
+    boolv[i] = batch.bool_val.ravel()[boolsel].astype(u32)
+
+    d[:, 4] = (
+        batch.str_len.astype(u32)
+        | (batch.str_has_glob.astype(u32) << 7)
+        | (boolv << 8)
+        | (durany << 9)
+        | (plain << 10)
+    )
+    return cells, bmeta, batch.str_bytes, d
+
+
+def unpack_batch(cells, bmeta, str_bytes, dictv, xp=np):
+    """Inverse of pack_batch: reconstruct the 22 build_eval_fn arguments.
+
+    Works on numpy arrays (tests, host fallback) or traced jax arrays
+    (inside build_eval_fn_packed's jit, where XLA fuses the bit ops and
+    dictionary gathers into the evaluation kernel)."""
+    w0 = cells[..., 0]
+    meta = cells[..., 1]
+    str_id = w0.astype(xp.int32) - 1
+    mask = (meta & 0xFFFF).astype(xp.uint16)
+    type_tag = ((meta >> 16) & 7).astype(xp.int8)
+    slot_valid = ((meta >> 19) & 1).astype(bool)
+    null_break = ((meta >> 20) & 1).astype(bool)
+    num_int = ((meta >> 21) & 1).astype(bool)
+    elem0 = ((meta >> 22) & 0xFF).astype(xp.int32) - 1
+
+    sid_safe = xp.maximum(str_id, 0)
+    present = str_id >= 0
+    tag_i = type_tag.astype(xp.int32)
+    is_numlike = (tag_i == T_NUM) | (tag_i == T_STR)
+    is_str = tag_i == T_STR
+    is_bool = tag_i == T_BOOL
+
+    def gather(col):
+        return xp.take(dictv[:, col], sid_safe)
+
+    d0, d1, d2, d3, d4 = (gather(c) for c in range(5))
+    num_ok = ((d0 >> 31) & 1).astype(bool) & present & is_numlike
+    num_lo = xp.where(num_ok, (d0 & 0x7FFFFFFF).astype(xp.int32), 0)
+    num_hi = xp.where(num_ok, d1.astype(xp.int32), 0)
+    num_plain = ((d4 >> 10) & 1).astype(bool) & present & is_numlike
+    dur_any = ((d4 >> 9) & 1).astype(bool) & present & is_str
+    dur_ok = ((d2 >> 31) & 1).astype(bool) & present & is_str
+    dur_lo = xp.where(dur_any, (d2 & 0x7FFFFFFF).astype(xp.int32), 0)
+    dur_hi = xp.where(dur_any, d3.astype(xp.int32), 0)
+    bool_val = ((d4 >> 8) & 1).astype(bool) & present & is_bool
+    num_int = num_int & is_numlike
+
+    kind_id = (bmeta & 0xFFFF).astype(xp.int32) - 1
+    host_flag = ((bmeta >> 16) & 1).astype(bool)
+    live = ((bmeta >> 17) & 1).astype(bool)
+    str_len = (dictv[:, 4] & 0x7F).astype(xp.int32)
+    str_has_glob = ((dictv[:, 4] >> 7) & 1).astype(bool)
+    return (mask, slot_valid, null_break, type_tag, str_id, num_hi, num_lo,
+            num_ok, num_plain, num_int, dur_hi, dur_lo, dur_ok, dur_any,
+            bool_val, elem0, kind_id, host_flag, live,
+            str_bytes, str_len, str_has_glob)
+
+
+@dataclass
+class PackedBatch:
+    """Flattened batch in the packed transfer form — the native
+    flattener's direct output (ktpu_flatten_packed). Carries exactly what
+    the device kernels consume; the 22 unpacked lanes and the decoded
+    string list materialize lazily for oracle/debug consumers."""
+
+    n: int
+    e: int
+    cells: np.ndarray         # [B, P, E, 2] uint32
+    bmeta: np.ndarray         # [B] uint32
+    str_bytes: np.ndarray     # [V, STR_LEN] uint8
+    dictv: np.ndarray         # [V, 5] uint32
+
+    def packed_args(self) -> tuple:
+        return (self.cells, self.bmeta, self.str_bytes, self.dictv)
+
+    def packed_blob(self) -> tuple[np.ndarray, tuple[int, int, int, int]]:
+        blob = getattr(self, "_blob", None)
+        if blob is None:
+            blob = _assemble_blob(*self.packed_args())
+            object.__setattr__(self, "_blob", blob)
+        return blob
+
+    @property
+    def strings(self) -> list[str]:
+        out = getattr(self, "_strings", None)
+        if out is None:
+            lens = self.dictv[:, 4] & 0x7F
+            out = [
+                bytes(self.str_bytes[i, : lens[i]]).decode(
+                    "utf-8", "surrogateescape")
+                for i in range(int(self.dictv.shape[0]))
+            ]
+            object.__setattr__(self, "_strings", out)
+        return out
+
+    def to_flat(self) -> "FlatBatch":
+        """Unpack into the eager lane form (tests, host-side consumers)."""
+        flat = getattr(self, "_flat", None)
+        if flat is None:
+            lanes = unpack_batch(self.cells, self.bmeta, self.str_bytes,
+                                 self.dictv, xp=np)
+            kw = dict(zip(BATCH_ARRAYS + DICT_ARRAYS, lanes))
+            num_val = (kw["num_hi"].astype(np.int64) << 31) | kw["num_lo"]
+            flat = FlatBatch(n=self.n, e=self.e, num_val=num_val,
+                             strings=self.strings, **kw)
+            object.__setattr__(self, "_flat", flat)
+        return flat
+
+
+def pad_packed(cells: np.ndarray, bmeta: np.ndarray,
+               multiple: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Pad the packed batch axis to a multiple of the mesh size. Zero fill
+    is the natural dead encoding: sid word 0 = no string, meta 0 = invalid
+    slot, bmeta 0 = unknown kind + not live."""
+    b = cells.shape[0]
+    padded = (b + multiple - 1) // multiple * multiple
+    if padded == b:
+        return cells, bmeta, b
+    pad = padded - b
+    cells = np.pad(cells, [(0, pad)] + [(0, 0)] * (cells.ndim - 1))
+    bmeta = np.pad(bmeta, (0, pad))
+    return cells, bmeta, b
+
+
+def pad_to_buckets_packed(batch: PackedBatch) -> tuple[PackedBatch, int]:
+    """Power-of-two bucket padding for the packed form (admission batching:
+    one XLA compile per shape bucket, zero fill = dead rows/slots/strings).
+    Returns (padded, original_n)."""
+    B, P, E, _ = batch.cells.shape
+    V = int(batch.dictv.shape[0])
+    b2, e2, v2 = _next_pow2(B), _next_pow2(E), _next_pow2(max(1, V))
+    if (b2, e2, v2) == (B, E, V):
+        return batch, B
+    cells = np.pad(batch.cells, [(0, b2 - B), (0, 0), (0, e2 - E), (0, 0)])
+    bmeta = np.pad(batch.bmeta, (0, b2 - B))
+    dictv = np.pad(batch.dictv, [(0, v2 - V), (0, 0)])
+    str_bytes = np.pad(batch.str_bytes, [(0, v2 - V), (0, 0)])
+    return PackedBatch(n=b2, e=e2, cells=cells, bmeta=bmeta,
+                       str_bytes=str_bytes, dictv=dictv), B
 
 
 class _Interner:

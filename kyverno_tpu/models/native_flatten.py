@@ -75,6 +75,17 @@ def _load_lib():
         ] + [ctypes.c_void_p] * 19 + [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
         ]
+        lib.ktpu_flatten_packed.restype = ctypes.c_int
+        lib.ktpu_flatten_packed.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_int64,       # docs
+            ctypes.c_char_p, ctypes.c_int64,       # reqs (nullable)
+            ctypes.c_int, ctypes.c_int,            # n_docs, max_slots
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int32),  # e_cap, e_needed
+            ctypes.c_void_p, ctypes.c_void_p,      # cells, bmeta
+            ctypes.c_void_p, ctypes.c_void_p,      # dictv, str_bytes
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
+        ]
         _lib = lib
         return lib
 
@@ -107,6 +118,20 @@ class NativeFlattener:
             STR_LEN, REQ_MARK.encode("utf-8"), NSEFF_MARK.encode("utf-8"),
         )
         self._lib = lib
+        # sticky capacity guesses: a wrong guess costs a full re-flatten
+        # pass, and scan chunks repeat the same shape chunk after chunk.
+        # The dictionary guess is a per-document ratio, not an absolute —
+        # a 65k-doc scan must not inflate every later single-resource
+        # admission allocation to scan size
+        self._e_guess = 0
+        self._str_per_doc = 0.0
+
+    def _str_cap_guess(self, B: int) -> int:
+        return max(1 << 14, 2 * B, int(B * self._str_per_doc * 1.25) + 64)
+
+    def _record_caps(self, B: int, e_used: int, n_strings: int) -> None:
+        self._e_guess = max(self._e_guess, e_used)
+        self._str_per_doc = max(self._str_per_doc, n_strings / max(1, B))
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -127,9 +152,12 @@ class NativeFlattener:
             return None
 
         # most batches need 1-4 slots per path; retry with the full stride
-        # when a document exceeds the initial guess (-4)
-        e_cap = min(4, max_slots)
-        str_cap = 1 << 14
+        # when a document exceeds the initial guess (-4). The dictionary
+        # guess scales with the batch (unique metadata.name values alone
+        # exceed a fixed cap on scan-sized chunks, and each miss repeats
+        # the whole flatten pass).
+        e_cap = min(max(4, self._e_guess), max_slots)
+        str_cap = self._str_cap_guess(B)
         while True:
             E = e_cap
             mask = np.zeros((B, P, E), dtype=np.uint16)
@@ -180,6 +208,7 @@ class NativeFlattener:
             if e_used < 0:
                 return None
             break
+        self._record_caps(B, e_used, n_strings.value)
 
         V = n_strings.value
         strings = [
@@ -215,6 +244,72 @@ class NativeFlattener:
         )
 
 
+    def flatten_packed(self, resources: list[dict] | None = None,
+                       max_slots: int = 16,
+                       requests: list[dict] | None = None,
+                       json_docs: bytes | None = None,
+                       n_docs: int | None = None,
+                       json_reqs: bytes | None = None):
+        """Flatten straight into the packed transfer form (PackedBatch),
+        or None on any failure. ``json_docs`` (a JSON array of documents,
+        e.g. the items of an apiserver list response) skips the Python
+        json.dumps — the scan regime's input is wire bytes, and the dumps
+        held the GIL for as long as the whole native parse took."""
+        from .flatten import PackedBatch
+
+        if json_docs is not None:
+            docs, B = json_docs, int(n_docs)
+            reqs = json_reqs
+        else:
+            B = len(resources)
+            try:
+                docs = json.dumps(resources).encode("utf-8")
+                reqs = (json.dumps(requests).encode("utf-8")
+                        if requests is not None else None)
+            except (TypeError, ValueError):
+                return None
+        P = self.tensors.n_paths
+
+        e_cap = min(max(4, self._e_guess), max_slots)
+        str_cap = self._str_cap_guess(B)
+        while True:
+            E = e_cap
+            cells = np.zeros((B, P, E, 2), dtype=np.uint32)
+            bmeta = np.zeros(B, dtype=np.uint32)
+            dictv = np.zeros((str_cap, 5), dtype=np.uint32)
+            str_bytes = np.zeros((str_cap, STR_LEN), dtype=np.uint8)
+            n_strings = ctypes.c_int32(0)
+            e_needed = ctypes.c_int32(0)
+            e_used = self._lib.ktpu_flatten_packed(
+                self._handle, docs, len(docs), reqs,
+                len(reqs) if reqs is not None else 0,
+                B, max_slots, e_cap, ctypes.byref(e_needed),
+                _ptr(cells), _ptr(bmeta), _ptr(dictv), _ptr(str_bytes),
+                ctypes.byref(n_strings), str_cap,
+            )
+            if e_used == -1:
+                str_cap = max(str_cap * 2, n_strings.value)
+                if str_cap > (1 << 24):
+                    return None
+                continue
+            if e_used == -4:
+                e_cap = max(e_cap + 1, e_needed.value)
+                continue
+            if e_used < 0:
+                return None
+            break
+        self._record_caps(B, e_used, n_strings.value)
+
+        V = max(1, n_strings.value)
+        if e_used < E:
+            cells = np.ascontiguousarray(cells[:, :, :e_used, :])
+        return PackedBatch(
+            n=B, e=e_used, cells=cells, bmeta=bmeta,
+            # copies, not views: a view pins the full str_cap buffers
+            str_bytes=str_bytes[:V].copy(), dictv=dictv[:V].copy(),
+        )
+
+
 def flatten_batch_fast(resources: list[dict], tensors: PolicyTensors,
                        max_slots: int = 16,
                        requests: list[dict] | None = None,
@@ -222,17 +317,54 @@ def flatten_batch_fast(resources: list[dict], tensors: PolicyTensors,
     """Native flatten with transparent Python fallback; the drop-in
     replacement for :func:`flatten_batch` used by CompiledPolicySet."""
     if native_available():
-        ctx = _cache.get(id(tensors))
-        if ctx is None or ctx.tensors is not tensors:
-            try:
-                ctx = NativeFlattener(tensors)
-            except RuntimeError:
-                ctx = None
-            _cache.clear()          # one compiled set at a time is typical
-            _cache[id(tensors)] = ctx
+        ctx = _flattener_for(tensors)
         if ctx is not None:
             out = ctx.flatten(resources, max_slots=max_slots, requests=requests)
             if out is not None:
                 return out
     return flatten_batch(resources, tensors, max_slots=max_slots,
                          requests=requests)
+
+
+def _flattener_for(tensors: PolicyTensors, _cache: dict = {}):
+    ctx = _cache.get(id(tensors))
+    if ctx is None or ctx.tensors is not tensors:
+        try:
+            ctx = NativeFlattener(tensors)
+        except RuntimeError:
+            ctx = None
+        _cache.clear()              # one compiled set at a time is typical
+        _cache[id(tensors)] = ctx
+    return ctx
+
+
+def flatten_packed_fast(tensors: PolicyTensors,
+                        resources: list[dict] | None = None,
+                        max_slots: int = 16,
+                        requests: list[dict] | None = None,
+                        json_docs: bytes | None = None,
+                        n_docs: int | None = None,
+                        json_reqs: bytes | None = None):
+    """PackedBatch via the native packed flattener, falling back to the
+    Python flattener + pack_batch (still a PackedBatch, just slower)."""
+    from .flatten import PackedBatch
+
+    if native_available():
+        ctx = _flattener_for(tensors)
+        if ctx is not None:
+            out = ctx.flatten_packed(
+                resources, max_slots=max_slots, requests=requests,
+                json_docs=json_docs, n_docs=n_docs, json_reqs=json_reqs)
+            if out is not None:
+                return out
+    if resources is None:
+        resources = json.loads(json_docs)
+        requests = json.loads(json_reqs) if json_reqs is not None else None
+    fb = flatten_batch(resources, tensors, max_slots=max_slots,
+                       requests=requests)
+    cells, bmeta, str_bytes, dictv = fb.packed_args()
+    pb = PackedBatch(n=fb.n, e=fb.e, cells=cells, bmeta=bmeta,
+                     str_bytes=str_bytes, dictv=dictv)
+    object.__setattr__(pb, "_flat", fb)
+    object.__setattr__(pb, "_strings", fb.strings)
+    return pb

@@ -841,3 +841,81 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
         return verdict.astype(jnp.int8)
 
     return jax.jit(evaluate) if jit else evaluate
+
+
+def build_eval_fn_packed(tensors: PolicyTensors, jit: bool = True):
+    """Packed-transfer variant of :func:`build_eval_fn`: takes
+    (cells, bmeta, str_bytes, dictv) — see flatten.PACKED_BATCH_ARRAYS —
+    and unpacks the 22 evaluation lanes on device (bit ops + dictionary
+    gathers that XLA fuses into the kernel). Cuts H2D to ~8 bytes/cell
+    over 4 arrays, which dominates e2e rate on tunnel-attached chips."""
+    from ..models.flatten import unpack_batch
+
+    base = build_eval_fn(tensors, jit=False)
+
+    def evaluate_packed(cells, bmeta, str_bytes, dictv):
+        return base(*unpack_batch(cells, bmeta, str_bytes, dictv, xp=jnp))
+
+    return jax.jit(evaluate_packed) if jit else evaluate_packed
+
+
+def _split_blob(blob, B: int, P: int, E: int, V: int):
+    """Slice one uint32 transfer buffer (FlatBatch.packed_blob) back into
+    (cells, bmeta, str_bytes, dictv). The string bytes travel as uint32
+    words; explicit little-endian shifts (not bitcast) keep the layout
+    backend-independent."""
+    from ..models.compiler import STR_LEN
+
+    w = STR_LEN // 4          # uint32 words per dictionary string
+    o0 = B * P * E * 2
+    cells = blob[:o0].reshape(B, P, E, 2)
+    bmeta = blob[o0:o0 + B]
+    o1 = o0 + B
+    dictv = blob[o1:o1 + V * 5].reshape(V, 5)
+    o2 = o1 + V * 5
+    sw = blob[o2:o2 + V * w].reshape(V, w)
+    str_bytes = jnp.stack(
+        [(sw >> s) & 0xFF for s in (0, 8, 16, 24)], axis=-1,
+    ).reshape(V, STR_LEN).astype(jnp.uint8)
+    return cells, bmeta, str_bytes, dictv
+
+
+def build_eval_fn_blob(tensors: PolicyTensors):
+    """Single-transfer variant: fn(blob, B, P, E, V) -> verdict [B, R].
+    Shapes are static jit arguments (one compile per chunk geometry)."""
+    from functools import partial
+
+    from ..models.flatten import unpack_batch
+
+    base = build_eval_fn(tensors, jit=False)
+
+    @partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    def evaluate_blob(blob, B, P, E, V):
+        parts = _split_blob(blob, B, P, E, V)
+        return base(*unpack_batch(*parts, xp=jnp))
+
+    return evaluate_blob
+
+
+def build_scan_fn_blob(tensors: PolicyTensors):
+    """Background-scan kernel: fn(blob, B, P, E, V) ->
+    (fail_counts [R] i32, pass_counts [R] i32, host_rows [B] bool).
+    The per-rule counts reduce on device so the scan reads back ~bytes,
+    not the [B, R] verdict matrix — the D2H round trip was a fifth of the
+    1M-scan wall time (BENCH_r03 config 5)."""
+    from functools import partial
+
+    from ..models.flatten import unpack_batch
+
+    base = build_eval_fn(tensors, jit=False)
+
+    @partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    def scan_blob(blob, B, P, E, V):
+        parts = _split_blob(blob, B, P, E, V)
+        v = base(*unpack_batch(*parts, xp=jnp))
+        fails = (v == V_FAIL).sum(axis=0, dtype=jnp.int32)
+        passes = (v == V_PASS).sum(axis=0, dtype=jnp.int32)
+        host_rows = (v == V_HOST).any(axis=1)
+        return fails, passes, host_rows
+
+    return scan_blob

@@ -19,7 +19,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.engine import CompiledPolicySet
-from ..models.flatten import BATCH_ARRAYS, DICT_ARRAYS, FlatBatch
+from ..models.flatten import (
+    BATCH_ARRAYS,
+    FlatBatch,
+    pad_packed,
+    unpack_batch,
+)
 from ..ops.eval import V_FAIL, V_HOST, V_PASS
 
 
@@ -49,17 +54,18 @@ def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
 
 
 def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
-    """jit the verdict computation with the batch axis sharded over the
-    mesh; XLA partitions the whole dataflow (GSPMD), no collectives needed
-    until the count reduction."""
+    """jit the verdict computation over the packed transfer form with the
+    batch axis sharded over the mesh; XLA partitions the whole dataflow
+    (GSPMD), no collectives needed until the count reduction. The packed
+    cells/bmeta shard over ``axis``; the string dictionary replicates."""
     from ..ops.eval import build_eval_fn
 
     base = build_eval_fn(cps.tensors, jit=False)
     data = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
 
-    def step(*args):
-        verdict = base(*args)
+    def step(cells, bmeta, str_bytes, dictv):
+        verdict = base(*unpack_batch(cells, bmeta, str_bytes, dictv, xp=jnp))
         # report aggregation: per-rule pass/fail counts across the whole
         # sharded batch -> all-reduce over ICI
         fails = jnp.sum(verdict == V_FAIL, axis=0)
@@ -68,8 +74,7 @@ def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
 
     return jax.jit(
         step,
-        in_shardings=tuple([data] * len(BATCH_ARRAYS)
-                           + [repl] * len(DICT_ARRAYS)),
+        in_shardings=(data, data, repl, repl),
         out_shardings=(data, repl, repl),
     )
 
@@ -100,8 +105,9 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     fn = sharded_eval_fn(cps, mesh, axis)
 
     def eval_chunk(chunk: list[dict]):
-        batch, n = pad_batch(cps.flatten(chunk), mesh.devices.size)
-        verdict, fails, passes = fn(*batch.device_args())
+        pb = cps.flatten_packed(chunk)
+        cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, mesh.devices.size)
+        verdict, fails, passes = fn(cells, bmeta, pb.str_bytes, pb.dictv)
         # materialize here: backpressure — the worker owns its chunk until
         # the device is done with it
         return np.array(verdict)[:n], np.array(fails), np.array(passes)

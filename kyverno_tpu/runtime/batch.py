@@ -189,7 +189,7 @@ class AdmissionBatcher:
         and after policy changes (the north star's 'precompiled policy
         tensor at controller start'), so the first real burst never pays
         XLA compilation inline."""
-        from ..models.flatten import pad_to_buckets
+        from ..models.flatten import pad_to_buckets_packed
 
         try:
             cps = self.policy_cache.compiled(ptype, kind, namespace)
@@ -199,8 +199,9 @@ class AdmissionBatcher:
             return
         for b in batch_sizes:
             try:
-                batch, _ = pad_to_buckets(cps.flatten([resource] * b))
-                shape_key = (batch.n, batch.e, int(batch.str_len.shape[0]))
+                batch, _ = pad_to_buckets_packed(
+                    cps.flatten_packed([resource] * b))
+                shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
                 cps.evaluate_device(batch)          # compile
                 t0 = time.monotonic()
                 cps.evaluate_device(batch)          # measure steady state
@@ -328,14 +329,14 @@ class AdmissionBatcher:
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
-            from ..models.flatten import pad_to_buckets
+            from ..models.flatten import pad_to_buckets_packed
 
             resources = [r for r, _ in items]
             t0 = time.monotonic()
             # bucket the batch shape so XLA compiles once per bucket, not
             # once per distinct admission batch
-            batch, _ = pad_to_buckets(cps.flatten(resources))
-            shape_key = (batch.n, batch.e, int(batch.str_len.shape[0]))
+            batch, _ = pad_to_buckets_packed(cps.flatten_packed(resources))
+            shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
             with self._lock:
                 cold = shape_key not in self._seen_shapes.setdefault(cps,
                                                                      set())

@@ -981,3 +981,274 @@ int ktpu_flatten_batch(
 }
 
 }  // extern "C"
+
+// ------------------------------------------------- packed transfer format
+
+namespace {
+
+// Per-unique-string dictionary row (models/flatten.py pack_batch layout):
+//   d0: num_lo(31) | num_ok<<31        d1: num_hi (two's complement)
+//   d2: dur_lo(31) | dur_ok<<31        d3: dur_hi (two's complement)
+//   d4: str_len(7) | has_glob<<7 | bool_val<<8 | dur_any<<9 | num_plain<<10
+// plus flattener-internal bits (never emitted): host (string routes the
+// resource to the CPU oracle) and pyint (int(s, 10)-parseable — the
+// num_int *cell* bit for T_STR leaves).
+struct DictRow {
+    uint32_t d[5] = {0, 0, 0, 0, 0};
+    bool host = false;
+    bool pyint = false;
+};
+
+DictRow analyze_string(const std::string& s, int L) {
+    DictRow r;
+    uint32_t ln = uint32_t(int(s.size()) < L ? int(s.size()) : L);
+    bool glob = s.find('*') != std::string::npos ||
+                s.find('?') != std::string::npos;
+    r.d[4] = ln | (uint32_t(glob) << 7) | (uint32_t(s == "true") << 8);
+    // mirror the T_STR leaf branch order exactly: a host-parse or
+    // digit-capped string leaves every value lane empty (incl. num_int)
+    if (needs_python_parse(s)) { r.host = true; return r; }
+    int64_t micro;
+    bool capped = false;
+    const bool q_ok = quantity_to_micro(s, &micro, &capped);
+    if (!q_ok && capped) { r.host = true; return r; }
+    r.pyint = py_int_ok(s);
+    if (q_ok) {
+        r.d[0] = uint32_t(micro & 0x7FFFFFFF) | (uint32_t(1) << 31);
+        r.d[1] = uint32_t(uint64_t(micro >> 31) & 0xFFFFFFFFu);
+        if (py_float_ok(s)) r.d[4] |= uint32_t(1) << 10;
+    }
+    int64_t dmicro;
+    if (duration_micro(s, &dmicro)) {
+        r.d[2] = uint32_t(dmicro & 0x7FFFFFFF) |
+                 (uint32_t(s != "0") << 31);
+        r.d[3] = uint32_t(uint64_t(dmicro >> 31) & 0xFFFFFFFFu);
+        r.d[4] |= uint32_t(1) << 9;
+    }
+    return r;
+}
+
+// Interner that analyzes each unique string once — the per-leaf value
+// parsing (quantity/duration/int/float) that dominated the unpacked
+// flattener's leaf loop amortizes across every repeated occurrence.
+struct PackedInterner {
+    std::unordered_map<std::string, int32_t> index;
+    std::vector<std::string> strings;
+    std::vector<DictRow> rows;
+    int L;
+
+    explicit PackedInterner(int cap) : L(cap) {}
+
+    int32_t intern(const std::string& s) {
+        auto it = index.find(s);
+        if (it != index.end()) return it->second;
+        int32_t id = int32_t(strings.size());
+        index.emplace(s, id);
+        strings.push_back(s);
+        rows.push_back(analyze_string(s, L));
+        return id;
+    }
+};
+
+constexpr uint32_t ELEM0_CAP = 254;  // mirrors flatten.ELEM0_CAP
+
+}  // namespace
+
+extern "C" {
+
+// Flatten a batch straight into the packed transfer form
+// (flatten.PACKED_BATCH_ARRAYS): cells uint32 [B,P,e_cap,2], bmeta uint32
+// [B], dictv uint32 [str_cap,5], str_bytes uint8 [str_cap,L]. Same input
+// conventions and -1/-2/-3/-4 retry protocol as ktpu_flatten_batch.
+// Differences from the unpacked form are exactly the packed-lane caps:
+// a resource hosts when elem0 exceeds ELEM0_CAP or a numeric/duration
+// value lives on a string too long to intern (the cell lanes that carried
+// such values are gone; the CPU oracle re-walks the document instead).
+int ktpu_flatten_packed(
+    void* handle,
+    const char* docs, int64_t docs_len,
+    const char* reqs, int64_t reqs_len,
+    int n_docs, int max_slots, int e_cap, int32_t* e_needed,
+    uint32_t* cells, uint32_t* bmeta, uint32_t* dictv,
+    uint8_t* str_bytes,
+    int32_t* n_strings, int str_cap) {
+
+    Ctx* ctx = static_cast<Ctx*>(handle);
+    const int P = int(ctx->paths.size());
+    const int E = e_cap;
+    const int L = ctx->str_len_cap;
+
+    Arena arena;
+    ArrayStream doc_stream{Parser{docs, docs + docs_len, &arena}};
+    ArrayStream req_stream{Parser{reqs, reqs + (reqs ? reqs_len : 0), &arena}};
+
+    PackedInterner interner(L);
+    int e_used = 1;
+    std::vector<Slot> slots;
+    Value nseff_leaf;
+    nseff_leaf.t = Value::Str;
+
+    for (int b = 0; b < n_docs; ++b) {
+        arena.reset();
+        const Value* root = doc_stream.next();
+        if (!doc_stream.parser.ok) return -2;
+        if (root == nullptr) return -3;
+        const Value* env = nullptr;
+        if (reqs != nullptr) {
+            env = req_stream.next();
+            if (!req_stream.parser.ok) return -2;
+            if (env == nullptr) return -3;
+        }
+        const bool env_nonempty =
+            env != nullptr && env->t == Value::Obj && !env->obj.empty();
+
+        int32_t kid = -1;
+        bool host = false;
+        std::string ns_eff;
+        if (root != nullptr && root->t == Value::Obj) {
+            const Value* kind_v = obj_get(root, "kind");
+            std::string kind = kind_v && kind_v->t == Value::Str ? kind_v->str : "";
+            auto it = ctx->kinds.find(kind);
+            if (it != ctx->kinds.end()) kid = it->second;
+            const Value* meta = obj_get(root, "metadata");
+            const Value* nv = obj_get(
+                meta, kind == "Namespace" ? "name" : "namespace");
+            if (nv != nullptr && nv->t == Value::Str) ns_eff = nv->str;
+        }
+
+        for (int p = 0; p < P; ++p) {
+            slots.clear();
+            const auto& segs = ctx->paths[p];
+            if (!segs.empty() && segs[0] == ctx->nseff_mark) {
+                nseff_leaf.str = ns_eff;
+                slots.push_back({0b11, -1, &nseff_leaf, true, false});
+            } else if (!segs.empty() && segs[0] == ctx->req_mark) {
+                uint16_t base_mask = env_nonempty ? 0b11 : 0b1;
+                if (segs.size() == 1 || !env_nonempty) {
+                    slots.push_back({base_mask, -1, nullptr, false, false});
+                } else {
+                    walk_slots(env, segs, 1, 0, base_mask, -1, slots, max_slots);
+                }
+            } else if (root == nullptr || root->t == Value::Null) {
+                slots.push_back({0b1, -1, nullptr, false, false});
+            } else {
+                walk_slots(root, segs, 0, 0, 0b1, -1, slots, max_slots);
+            }
+
+            if (int(slots.size()) > max_slots) {
+                host = true;
+                slots.resize(size_t(max_slots));
+            }
+            if (int(slots.size()) > E) {
+                *e_needed = int(slots.size());
+                return -4;
+            }
+            if (int(slots.size()) > e_used) e_used = int(slots.size());
+
+            uint32_t* row = cells + (size_t(b) * P + p) * size_t(E) * 2;
+            for (int e = 0; e < int(slots.size()); ++e) {
+                const Slot& slot = slots[size_t(e)];
+                uint32_t e0w;
+                if (slot.elem0 < 0) {
+                    e0w = 0;
+                } else if (uint32_t(slot.elem0) >= ELEM0_CAP) {
+                    e0w = 255;
+                    host = true;
+                } else {
+                    e0w = uint32_t(slot.elem0) + 1;
+                }
+                uint32_t tag = T_ABSENT;
+                int32_t sid = -1;
+                uint32_t numint = 0;
+                if (slot.leaf_present) {
+                    const Value* v = slot.leaf;
+                    switch (v->t) {
+                        case Value::Null:
+                            tag = T_NULL;
+                            break;
+                        case Value::Bool:
+                            tag = T_BOOL;
+                            sid = interner.intern(v->b ? "true" : "false");
+                            break;
+                        case Value::Num: {
+                            tag = T_NUM;
+                            numint = num_token_is_int(v->raw) ? 1 : 0;
+                            std::string text;
+                            if (numint) {
+                                text = std::string(v->raw);
+                                if (!text.empty() && text[0] == '+')
+                                    text.erase(0, 1);
+                            } else {
+                                double fv = 0.0;
+                                std::string tok(v->raw);
+                                std::from_chars(tok.data(),
+                                                tok.data() + tok.size(), fv);
+                                text = format_float_sci(fv);
+                            }
+                            if (int(text.size()) <= L) {
+                                sid = interner.intern(text);
+                            } else {
+                                // the packed value lanes live on the
+                                // dictionary row; without one the number
+                                // is unrepresentable -> CPU oracle
+                                host = true;
+                            }
+                            int64_t micro;
+                            if (!quantity_to_micro(v->raw, &micro))
+                                host = true;
+                            break;
+                        }
+                        case Value::Str: {
+                            tag = T_STR;
+                            if (int(v->str.size()) <= L) {
+                                sid = interner.intern(v->str);
+                                const DictRow& r = interner.rows[size_t(sid)];
+                                host |= r.host;
+                                numint = r.pyint ? 1 : 0;
+                            } else {
+                                host = true;
+                            }
+                            break;
+                        }
+                        case Value::Obj:
+                            tag = T_OBJ;
+                            break;
+                        case Value::Arr:
+                            tag = T_LIST;
+                            break;
+                    }
+                }
+                row[size_t(e) * 2] = uint32_t(sid + 1);
+                row[size_t(e) * 2 + 1] =
+                    uint32_t(slot.mask)
+                    | (tag << 16)
+                    | (uint32_t(1) << 19)                     // slot_valid
+                    | (uint32_t(slot.null_break ? 1 : 0) << 20)
+                    | (numint << 21)
+                    | (e0w << 22);
+            }
+        }
+        bmeta[b] = uint32_t(kid + 1)
+                   | (uint32_t(host ? 1 : 0) << 16)
+                   | (uint32_t(1) << 17);                     // live
+    }
+
+    if (!doc_stream.done) {
+        if (doc_stream.next() != nullptr || !doc_stream.done) return -3;
+        if (!doc_stream.parser.ok) return -2;
+    }
+
+    const int V = int(interner.strings.size());
+    *n_strings = V;
+    if (V > str_cap) return -1;
+    for (int v = 0; v < V; ++v) {
+        const std::string& s = interner.strings[size_t(v)];
+        int len = int(s.size()) < L ? int(s.size()) : L;
+        memcpy(str_bytes + size_t(v) * size_t(L), s.data(), size_t(len));
+        memcpy(dictv + size_t(v) * 5, interner.rows[size_t(v)].d,
+               5 * sizeof(uint32_t));
+    }
+    return e_used;
+}
+
+}  // extern "C"
