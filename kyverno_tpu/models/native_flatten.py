@@ -120,18 +120,22 @@ class NativeFlattener:
         self._lib = lib
         # sticky capacity guesses: a wrong guess costs a full re-flatten
         # pass, and scan chunks repeat the same shape chunk after chunk.
-        # The dictionary guess is a per-document ratio, not an absolute —
-        # a 65k-doc scan must not inflate every later single-resource
-        # admission allocation to scan size
+        # The dictionary guess is tracked per batch-size regime (log2
+        # bucket): per-doc string density is highest at B=1 and amortizes
+        # with batch size, so one regime's observation must not inflate
+        # (or starve) another's allocation
         self._e_guess = 0
-        self._str_per_doc = 0.0
+        self._str_by_bucket: dict[int, int] = {}
 
     def _str_cap_guess(self, B: int) -> int:
-        return max(1 << 14, 2 * B, int(B * self._str_per_doc * 1.25) + 64)
+        seen = self._str_by_bucket.get(B.bit_length(), 0)
+        return max(1 << 14, 2 * B, int(seen * 1.25))
 
     def _record_caps(self, B: int, e_used: int, n_strings: int) -> None:
         self._e_guess = max(self._e_guess, e_used)
-        self._str_per_doc = max(self._str_per_doc, n_strings / max(1, B))
+        bucket = B.bit_length()
+        self._str_by_bucket[bucket] = max(
+            self._str_by_bucket.get(bucket, 0), n_strings)
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

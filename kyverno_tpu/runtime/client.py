@@ -181,12 +181,24 @@ class RestConfig:
 
 
 class RestClient(Client):
-    """Minimal dynamic client over the K8s REST API (urllib; no kubectl)."""
+    """Dynamic client over the K8s REST API (urllib; no kubectl): CRUD
+    with bounded retry, plus the streaming-watch transport that drives
+    informers (runtime/watch.py) — the dclient + client-go reflector pair
+    (/root/reference/pkg/dclient/client.go, pkg/resourcecache)."""
 
-    def __init__(self, config: RestConfig, resource_map: dict[str, str] | None = None):
+    #: transient statuses worth one bounded retry round (client-go's
+    #: default retry set: throttled, server overloaded, gateway errors)
+    RETRYABLE = (429, 500, 502, 503, 504)
+
+    def __init__(self, config: RestConfig, resource_map: dict[str, str] | None = None,
+                 retries: int = 2, retry_backoff_s: float = 0.25):
         self.config = config
         # Kind -> plural resource name
         self.resource_map = resource_map or {}
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
+        self._hub = None
+        self._hub_lock = threading.Lock()
 
     def _plural(self, kind: str) -> str:
         if kind in self.resource_map:
@@ -211,7 +223,19 @@ class RestClient(Client):
             parts.append(name)
         return "/".join(parts)
 
-    def _request(self, method: str, url: str, body: dict | None = None):
+    def _ssl_context(self):
+        import ssl
+
+        if not self.config.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(cafile=self.config.ca_file or None)
+        if self.config.insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def _open(self, method: str, url: str, body: dict | None = None,
+              timeout: float = 15):
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
@@ -219,21 +243,37 @@ class RestClient(Client):
             req.add_header("Content-Type", "application/json")
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
-        import ssl
+        return urllib.request.urlopen(
+            req, context=self._ssl_context(), timeout=timeout)
 
-        ctx = ssl.create_default_context(
-            cafile=self.config.ca_file or None
-        )
-        if self.config.insecure:
-            ctx.check_hostname = False
-            ctx.verify_mode = ssl.CERT_NONE
-        try:
-            with urllib.request.urlopen(req, context=ctx, timeout=15) as resp:
-                return json.loads(resp.read() or b"null")
-        except urllib.error.HTTPError as e:
-            if e.code == 409:
-                raise ConflictError(str(e)) from e
-            raise
+    def _request(self, method: str, url: str, body: dict | None = None):
+        import time
+
+        idempotent = method in ("GET", "DELETE")
+        last = None
+        for attempt in range(self.retries + 1):
+            try:
+                with self._open(method, url, body) as resp:
+                    return json.loads(resp.read() or b"null")
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    raise ConflictError(str(e)) from e
+                # mutating verbs retry only on 429 (rejected before
+                # processing); a 502/504 gives no guarantee the write
+                # didn't land, and a re-POST would double-apply
+                retryable = (e.code in self.RETRYABLE if idempotent
+                             else e.code == 429)
+                if not retryable or attempt == self.retries:
+                    raise
+                last = e
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # connection-level failure: same asymmetry (a POST might
+                # have landed before the connection died)
+                if not idempotent or attempt == self.retries:
+                    raise
+                last = e
+            time.sleep(self.retry_backoff_s * (2 ** attempt))
+        raise last  # pragma: no cover - loop always returns or raises
 
     def get_resource(self, api_version, kind, namespace, name):
         try:
@@ -271,3 +311,64 @@ class RestClient(Client):
             self._request("DELETE", self._url(api_version, kind, namespace, name))
         except Exception:
             pass
+
+    # ------------------------------------------------------- watch / informers
+
+    def list_response(self, api_version: str, kind: str,
+                      namespace: str = "") -> dict:
+        """Full list document (items + metadata.resourceVersion) — the
+        reflector needs the list's rv to anchor its watch."""
+        return self._request(
+            "GET", self._url(api_version, kind, namespace)) or {}
+
+    def watch_stream(self, api_version: str, kind: str, namespace: str = "",
+                     resource_version: str | None = None,
+                     timeout_s: float = 300.0, stop=None):
+        """Yield (type, object) from a chunked ``?watch=true`` stream —
+        the k8s watch protocol: one JSON frame per line, resumable via
+        resourceVersion, with server bookmarks requested so the resume
+        point advances even on quiet kinds. Returns (ends the generator)
+        when the server closes the connection or ``stop`` is set; raises
+        on connection errors so the reflector can back off."""
+        from .watch import decode_watch_line
+
+        url = (self._url(api_version, kind, namespace)
+               + "?watch=true&allowWatchBookmarks=true"
+               + f"&timeoutSeconds={int(timeout_s)}")
+        if resource_version:
+            url += f"&resourceVersion={resource_version}"
+        resp = self._open("GET", url, timeout=timeout_s + 15)
+        try:
+            for line in resp:
+                if stop is not None and stop.is_set():
+                    return
+                frame = decode_watch_line(line)
+                if frame is None:
+                    continue
+                ev_type, obj = frame
+                if ev_type == "ERROR":
+                    # surface the Status code (410 Gone -> re-list)
+                    yield "ERROR", {"code": (obj or {}).get("code")}
+                    return
+                yield ev_type, obj
+        finally:
+            resp.close()
+
+    def ensure_informer(self, api_version: str, kind: str,
+                        namespace: str = "", on_event=None, on_sync=None):
+        """Idempotent per-GVK informer (list+watch reflector); callbacks
+        observe the full object stream. The ResourceCache calls this the
+        first time a kind is cached (resourcecache.go CreateGVKInformer)."""
+        from .watch import WatchHub
+
+        with self._hub_lock:
+            if self._hub is None:
+                self._hub = WatchHub(self)
+        return self._hub.ensure(api_version, kind, namespace,
+                                on_event=on_event, on_sync=on_sync)
+
+    def stop_informers(self) -> None:
+        with self._hub_lock:
+            if self._hub is not None:
+                self._hub.stop()
+                self._hub = None

@@ -66,6 +66,34 @@ class GenerateController:
                 n += 1
         return n
 
+    def watch_cluster(self) -> bool:
+        """Event-driven intake: pending GenerateRequests enqueue straight
+        off the watch stream (generaterequest informer in the reference's
+        main.go wiring) — after the initial sync the controller never
+        polls. Returns False when the client offers no watch transport."""
+        def on_event(ev_type: str, gr: dict) -> None:
+            if gr.get("kind") != "GenerateRequest":
+                return
+            if ev_type in ("ADDED", "MODIFIED") and (
+                    (gr.get("status") or {}).get("state")) == GR_PENDING:
+                self.enqueue(gr)
+
+        def on_sync(items: list[dict]) -> None:
+            # initial list and 410-triggered re-lists: GRs created before
+            # the watch anchored arrive here, not as events
+            for gr in items:
+                if ((gr.get("status") or {}).get("state")) == GR_PENDING:
+                    self.enqueue(gr)
+
+        if hasattr(self.client, "ensure_informer"):
+            self.client.ensure_informer("kyverno.io/v1", "GenerateRequest",
+                                        on_event=on_event, on_sync=on_sync)
+            return True
+        if hasattr(self.client, "watch"):
+            self.client.watch(on_event)
+            return True
+        return False
+
     # ------------------------------------------------------------ workers
 
     def run(self) -> None:

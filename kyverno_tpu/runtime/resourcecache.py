@@ -29,12 +29,16 @@ class _Entry:
 class ResourceCache:
     """pkg/resourcecache ResourceCache."""
 
-    def __init__(self, client, resync_s: float = 60.0):
+    def __init__(self, client, resync_s: float = 60.0,
+                 informer_sync_timeout_s: float = 10.0):
         self.client = client
         self.resync_s = resync_s
+        self.informer_sync_timeout_s = informer_sync_timeout_s
         self._lock = threading.Lock()
         self._entries: dict[tuple, _Entry] = {}
         self._watching = False
+        self._informed: dict[tuple, object] = {}  # (apiVersion, kind) -> Reflector
+        self._sync_waited: set[tuple] = set()
         self.lookups = 0
         self.fetches = 0
         if client is not None and hasattr(client, "watch"):
@@ -47,15 +51,51 @@ class ResourceCache:
 
     def _on_event(self, event: str, resource: dict) -> None:
         meta = resource.get("metadata") or {}
-        key = self._key(resource.get("kind", ""), meta.get("namespace", ""),
+        kind = resource.get("kind", "")
+        key = self._key(kind, meta.get("namespace", ""),
                         meta.get("name", ""))
         with self._lock:
-            if key not in self._entries:
-                return  # only kinds already cached are maintained
+            # informer-watched kinds hold complete state: upsert every
+            # event; the global FakeCluster watch only maintains keys a
+            # reader already populated
+            if key not in self._entries and not any(
+                    k == kind for _, k in self._informed):
+                return
             if event == "DELETED":
                 self._entries[key] = _Entry(None, time.monotonic())
             else:
                 self._entries[key] = _Entry(resource, time.monotonic())
+
+    def _on_informer_sync(self, kind: str, items: list[dict]) -> None:
+        """Full re-list for an informed kind: replace that kind's slice of
+        the cache wholesale (objects deleted during a watch outage must
+        not survive the re-list)."""
+        now = time.monotonic()
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == kind]:
+                del self._entries[key]
+            for r in items:
+                meta = r.get("metadata") or {}
+                key = self._key(kind, meta.get("namespace", ""),
+                                meta.get("name", ""))
+                self._entries[key] = _Entry(r, now)
+
+    def _ensure_informer(self, api_version: str, kind: str):
+        """First lookup of a kind on an informer-capable client starts its
+        reflector (resourcecache.go CreateGVKInformer) and waits for the
+        initial list; after that every lookup of the kind is a pure cache
+        read — including confirmed absences — with zero polling GETs."""
+        gvk = (api_version, kind)
+        with self._lock:
+            refl = self._informed.get(gvk)
+            if refl is None:
+                refl = self.client.ensure_informer(
+                    api_version, kind,
+                    on_event=self._on_event,
+                    on_sync=lambda items, k=kind: self._on_informer_sync(
+                        k, items))
+                self._informed[gvk] = refl
+        return refl
 
     def get(self, api_version: str, kind: str, namespace: str,
             name: str) -> dict | None:
@@ -63,6 +103,23 @@ class ResourceCache:
         window), read-through to the client otherwise."""
         self.lookups += 1
         key = self._key(kind, namespace, name)
+        if self.client is not None and hasattr(self.client, "ensure_informer"):
+            refl = self._ensure_informer(api_version, kind)
+            # block for the initial list only once per GVK — a reflector
+            # that cannot sync (RBAC-forbidden list, degraded apiserver)
+            # must not turn every lookup into a 10s stall; later lookups
+            # check non-blocking and read through until it recovers
+            gvk = (api_version, kind)
+            first = gvk not in self._sync_waited
+            self._sync_waited.add(gvk)
+            if refl.wait_synced(self.informer_sync_timeout_s if first
+                                else 0):
+                with self._lock:
+                    entry = self._entries.get(key)
+                    # complete state for this kind: a missing key IS a
+                    # confirmed absence, no GET needed
+                    return entry.resource if entry is not None else None
+            # informer not synced (apiserver hiccup): read through below
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)

@@ -230,6 +230,7 @@ class Controller:
         migrations.run_all(self.client, self.namespace)
         self.generate_controller.run()
         self.generate_controller.sync_from_cluster()
+        self.generate_controller.watch_cluster()
 
         def scan_loop():
             while not self._stop.is_set():
