@@ -191,8 +191,18 @@ _SCHEMAS: dict[str, dict] = {
 
 
 def register_schema(kind: str, schema: dict) -> None:
-    """The crdSync seam: add/replace a kind schema at runtime."""
+    """The crdSync seam: add/replace a kind schema at runtime
+    (policy/crd_sync.py fills it from CRDs + the cluster document)."""
     _SCHEMAS[kind] = schema
+
+
+def unregister_schema(kind: str) -> None:
+    """Drop a synced schema (CRD deleted); bundled core kinds stay."""
+    if kind not in _BUNDLED:
+        _SCHEMAS.pop(kind, None)
+
+
+_BUNDLED = frozenset(_SCHEMAS)
 
 
 def has_schema(kind: str) -> bool:

@@ -60,6 +60,8 @@ class FakeCluster(Client):
         self._store: dict[tuple[str, str, str], dict] = {}
         self._watchers: list = []
         self._rv = 0
+        #: optional /openapi/v2 swagger document served to CrdSync
+        self.openapi_document: dict | None = None
         # RBAC for SelfSubjectAccessReview: (verb, resource) pairs the
         # controller is NOT allowed; default allow-all
         self.deny_access: set[tuple[str, str]] = set()
@@ -129,6 +131,9 @@ class FakeCluster(Client):
             r = self._store.pop((kind, namespace or "", name), None)
             if r is not None:
                 self._notify("DELETED", r)
+
+    def get_openapi_v2(self) -> dict | None:
+        return self.openapi_document
 
     # informer-style change notification
     def watch(self, callback) -> None:
@@ -311,6 +316,13 @@ class RestClient(Client):
             self._request("DELETE", self._url(api_version, kind, namespace, name))
         except Exception:
             pass
+
+    def get_openapi_v2(self) -> dict | None:
+        """The cluster's /openapi/v2 swagger document (crdSync.go:57)."""
+        try:
+            return self._request("GET", f"{self.config.server}/openapi/v2")
+        except Exception:
+            return None
 
     # ------------------------------------------------------- watch / informers
 

@@ -106,6 +106,9 @@ class Controller:
         self.monitor = Monitor(self.register, self.cert_renewer)
         self.webhook_manager = WebhookConfigManager(self.client, self.register)
         self.generate_controller = GenerateController(self.client, {})
+        from .policy.crd_sync import CrdSync
+
+        self.crd_sync = CrdSync(self.client)
         self.elector = LeaderElector(
             self.client, namespace=namespace,
             on_started_leading=self._start_leader_tasks,
@@ -219,6 +222,15 @@ class Controller:
         keyfile = self.cert_renewer.key_file if self.cert_renewer else ""
         self._httpd = self.webhook.run(host=host, port=self.serve_port,
                                        certfile=certfile, keyfile=keyfile)
+        # schema sync runs on EVERY replica, not just the leader: the
+        # policy-admission webhook consuming the schema store serves on
+        # every replica (reference wires crdSync unconditionally, main.go)
+        try:
+            self.crd_sync.run()
+        except Exception:
+            logging.getLogger("kyverno.crdsync").exception(
+                "CRD schema sync failed to start; CRD kinds will skip "
+                "policy mutate schema-checks")
         self.event_gen.run()
         self.elector.run()
         self.monitor.run()
@@ -268,8 +280,11 @@ class Controller:
         self.webhook.stop()
         self.event_gen.stop()
         self.generate_controller.stop()
+        self.crd_sync.stop()
         self.monitor.stop()
         self.elector.stop()
+        if hasattr(self.client, "stop_informers"):
+            self.client.stop_informers()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -367,3 +367,28 @@ class TestGenerateWatch:
         assert _wait(lambda: ctrl.queue.qsize() >= 1
                      if hasattr(ctrl.queue, "qsize") else len(ctrl.queue) >= 1)
         assert server.get_count == 0
+
+
+class TestCrdSyncOverWatch:
+    def test_fresh_crd_schema_arrives_via_stream(self, api):
+        """A CRD installed after startup reaches the schema store through
+        the watch transport — no polling (crdSync.go over our reflector)."""
+        from kyverno_tpu.policy.crd_sync import CrdSync
+        from kyverno_tpu.policy.openapi import has_schema, unregister_schema
+        from tests.unit.test_crd_sync import _crd
+
+        server, client = api
+        PLURALS["CustomResourceDefinition"] = "customresourcedefinitions"
+        sync = CrdSync(client)
+        try:
+            sync.run()
+            assert not has_schema("Gadget")
+            server.reset_counters()
+            server.upsert("CustomResourceDefinition", _crd())
+            assert _wait(lambda: has_schema("Gadget"))
+            assert server.get_count == 0
+            server.delete("CustomResourceDefinition", "", "gadgets.acme.io")
+            assert _wait(lambda: not has_schema("Gadget"))
+        finally:
+            sync.stop()
+            unregister_schema("Gadget")

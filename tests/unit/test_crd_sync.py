@@ -1,0 +1,220 @@
+"""CRD / openapi-document schema sync (policy/crd_sync.py vs reference
+pkg/openapi/crdSync.go): conversion of OpenAPI v3 CRD schemas and v2
+cluster documents into the structural DSL, live registration through the
+watch seam, and the end state the reference guarantees — a mutate policy
+writing schema-invalid fields into a freshly-installed CRD kind is
+rejected at policy admission instead of skipping validation."""
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.policy.crd_sync import (
+    CrdSync,
+    convert_openapi_schema,
+    schemas_from_crd,
+    schemas_from_openapi_v2,
+)
+from kyverno_tpu.policy.openapi import (
+    has_schema,
+    unregister_schema,
+    validate_policy_mutation,
+    validate_resource,
+)
+from kyverno_tpu.runtime.client import FakeCluster
+
+
+def _crd(kind="Gadget", group="acme.io", props=None, served=True):
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{kind.lower()}s.{group}"},
+        "spec": {
+            "group": group,
+            "names": {"kind": kind, "plural": f"{kind.lower()}s"},
+            "versions": [{
+                "name": "v1", "served": served, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "apiVersion": {"type": "string"},
+                        "kind": {"type": "string"},
+                        "metadata": {"type": "object",
+                                     "x-kubernetes-preserve-unknown-fields": True},
+                        "spec": {"type": "object", "properties": (props or {
+                            "replicas": {"type": "integer"},
+                            "mode": {"type": "string"},
+                            "port": {"x-kubernetes-int-or-string": True},
+                            "limits": {"type": "object",
+                                       "additionalProperties":
+                                           {"type": "string"}},
+                        })},
+                    },
+                }},
+            }],
+        },
+    }
+
+
+@pytest.fixture(autouse=True)
+def _clean_schemas():
+    yield
+    for kind in ("Gadget", "Widget"):
+        unregister_schema(kind)
+
+
+class TestConversion:
+    def test_basic_shapes(self):
+        s = convert_openapi_schema({
+            "type": "object",
+            "properties": {
+                "a": {"type": "string"},
+                "b": {"type": "array", "items": {"type": "integer"}},
+                "c": {"type": "object",
+                      "additionalProperties": {"type": "boolean"}},
+                "d": {"x-kubernetes-int-or-string": True},
+            }})
+        assert s["type"] == "object" and not s["open"]
+        assert s["fields"]["a"] == {"type": "string"}
+        assert s["fields"]["b"]["items"] == {"type": "integer"}
+        assert s["fields"]["c"] == {"type": "map",
+                                    "values": {"type": "boolean"}}
+        assert s["fields"]["d"] == {"type": "intstr"}
+
+    def test_ref_resolution_and_cycles(self):
+        defs = {
+            "Inner": {"type": "object",
+                      "properties": {"x": {"type": "string"},
+                                     "self": {"$ref": "#/definitions/Inner"}}},
+        }
+        s = convert_openapi_schema({"$ref": "#/definitions/Inner"}, defs)
+        assert s["fields"]["x"] == {"type": "string"}
+        # the cycle bottoms out permissively instead of recursing forever
+        assert s["fields"]["self"]["type"] in ("object", "any")
+
+    def test_unknown_shapes_stay_permissive(self):
+        assert convert_openapi_schema({}) == {"type": "any"}
+        assert convert_openapi_schema(
+            {"x-kubernetes-preserve-unknown-fields": True}) == {"type": "any"}
+
+    def test_openapi_v2_document(self):
+        doc = {"definitions": {
+            "io.acme.v1.Widget": {
+                "type": "object",
+                "properties": {"spec": {"$ref": "#/definitions/WidgetSpec"}},
+                "x-kubernetes-group-version-kind": [
+                    {"group": "acme.io", "kind": "Widget", "version": "v1"}],
+            },
+            "WidgetSpec": {"type": "object",
+                           "properties": {"size": {"type": "integer"}}},
+        }}
+        out = schemas_from_openapi_v2(doc)
+        assert out["Widget"]["fields"]["spec"]["fields"]["size"] == \
+            {"type": "integer"}
+
+
+class TestCrdSync:
+    def test_sync_once_registers_crd_kinds(self):
+        client = FakeCluster([_crd()])
+        assert not has_schema("Gadget")
+        sync = CrdSync(client)
+        assert sync.sync_once() >= 1
+        assert has_schema("Gadget")
+        assert validate_resource(
+            {"kind": "Gadget", "spec": {"replicas": 3}}, "Gadget") == []
+        assert validate_resource(
+            {"kind": "Gadget", "spec": {"replicas": "three"}}, "Gadget")
+        assert validate_resource(
+            {"kind": "Gadget", "spec": {"bogus": 1}}, "Gadget")
+
+    def test_watch_event_registers_and_unregisters(self):
+        client = FakeCluster()
+        sync = CrdSync(client)
+        sync.run()                       # FakeCluster: global watch seam
+        client.create_resource(_crd())
+        assert has_schema("Gadget")
+        client.delete_resource("apiextensions.k8s.io/v1",
+                               "CustomResourceDefinition", "",
+                               "gadgets.acme.io")
+        assert not has_schema("Gadget")
+
+    def test_openapi_document_feeds_sync(self):
+        client = FakeCluster()
+        client.openapi_document = {"definitions": {
+            "io.acme.v1.Widget": {
+                "type": "object",
+                "properties": {"kind": {"type": "string"},
+                               "apiVersion": {"type": "string"},
+                               "metadata": {
+                                   "x-kubernetes-preserve-unknown-fields": True},
+                               "spec": {"type": "object", "properties": {
+                                   "size": {"type": "integer"}}}},
+                "x-kubernetes-group-version-kind": [
+                    {"group": "acme.io", "kind": "Widget", "version": "v1"}],
+            }}}
+        CrdSync(client).sync_once()
+        assert has_schema("Widget")
+        assert validate_resource(
+            {"kind": "Widget", "spec": {"size": "big"}}, "Widget")
+
+    def test_mutate_policy_against_fresh_crd_is_schema_checked(self):
+        """The reference guarantee (crdSync.go + validation.go:143): before
+        the CRD lands its kind skips validation; after sync a mutate
+        policy writing a schema-invalid field is rejected."""
+        policy = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "set-replicas"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Gadget"]}},
+                "mutate": {"patchStrategicMerge": {
+                    "spec": {"replicas": "three"}}},
+            }]},
+        })
+        assert validate_policy_mutation(policy) == []   # unknown kind: skip
+
+        client = FakeCluster([_crd()])
+        CrdSync(client).sync_once()
+        errs = validate_policy_mutation(policy)
+        assert errs and "replicas" in errs[0]
+
+        # a schema-valid mutation still passes
+        ok_policy = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "set-replicas-ok"},
+            "spec": {"rules": [{
+                "name": "r",
+                "match": {"resources": {"kinds": ["Gadget"]}},
+                "mutate": {"patchStrategicMerge": {"spec": {"replicas": 3}}},
+            }]},
+        })
+        assert validate_policy_mutation(ok_policy) == []
+
+
+class TestReconcilePruning:
+    def test_sync_once_prunes_deleted_crds(self):
+        client = FakeCluster([_crd()])
+        sync = CrdSync(client)
+        sync.sync_once()
+        assert has_schema("Gadget")
+        client.delete_resource("apiextensions.k8s.io/v1",
+                               "CustomResourceDefinition", "",
+                               "gadgets.acme.io")
+        sync.sync_once()                  # ticker-mode full reconcile
+        assert not has_schema("Gadget")
+
+    def test_modified_crd_losing_schema_drops_kind(self):
+        client = FakeCluster()
+        sync = CrdSync(client)
+        sync.run()
+        client.create_resource(_crd())
+        assert has_schema("Gadget")
+        client.update_resource(_crd(served=False))
+        assert not has_schema("Gadget")
+
+    def test_stop_makes_callbacks_inert(self):
+        client = FakeCluster()
+        sync = CrdSync(client)
+        sync.run()
+        sync.stop()
+        client.create_resource(_crd())
+        assert not has_schema("Gadget")
