@@ -260,6 +260,11 @@ class WebhookServer:
                         self.registry, policy.name, rule.name,
                         rule.status.value, resource_kind=kind,
                         request_operation=request.get("operation", "CREATE"))
+                # verifyImages outcomes reach the report pipeline like
+                # validation results (reportcontroller consumes every
+                # engine response kind in the reference)
+                if self.report_gen is not None and resp.policy_response.rules:
+                    self.report_gen.add(resp)
                 if (not resp.successful
                         and policy.spec.validation_failure_action == "enforce"):
                     blocked_msgs += [r.message

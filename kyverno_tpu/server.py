@@ -79,7 +79,8 @@ class Controller:
     """The assembled process (everything main.go wires at :70-531)."""
 
     def __init__(self, client: Client | None = None, namespace: str = "kyverno",
-                 serve_port: int = 9443, enable_tls: bool = False):
+                 serve_port: int = 9443, enable_tls: bool = False,
+                 image_verifier=None):
         self.client = client if client is not None else FakeCluster()
         self.namespace = namespace
         self.serve_port = serve_port
@@ -95,11 +96,19 @@ class Controller:
         # to the CPU oracle and engages the device only when a burst
         # forms, so single-request latency never pays the device RTT
         self.admission_batcher = AdmissionBatcher(self.policy_cache)
+        if image_verifier is None:
+            # deployable default: key-based cosign verification against
+            # live registries (pkg/cosign is unconditionally real in the
+            # reference); tests/air-gapped runs inject StaticVerifier
+            from .engine.registry_verify import RegistryVerifier
+
+            image_verifier = RegistryVerifier()
         self.webhook = WebhookServer(
             policy_cache=self.policy_cache, config=self.config,
             client=self.client, event_gen=self.event_gen,
             report_gen=self.report_gen, registry=self.registry,
             admission_batcher=self.admission_batcher,
+            image_verifier=image_verifier,
         )
         ca = self.cert_renewer.ca_bundle() if self.cert_renewer else ""
         self.register = Register(self.client, ca_bundle=ca)

@@ -1,0 +1,425 @@
+"""RegistryVerifier against an in-process OCI registry stub.
+
+The stub speaks the Docker Registry HTTP API v2 (manifests, blobs,
+optional Bearer token auth) and the tests publish real cosign object
+layouts — SimpleSigning payloads with ECDSA-P256 signature annotations
+under ``sha256-<hex>.sig`` and DSSE in-toto envelopes under ``.att`` —
+so the verifier exercises the exact protocol and crypto a live registry
+would (/root/reference/pkg/cosign/cosign.go:30-103), not a mock trust
+store. The final class drives the whole stack through the production
+webhook: signed image -> digest patch, unsigned image -> block, and a
+PolicyReport row either way.
+"""
+
+import base64
+import hashlib
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kyverno_tpu.engine.image_verify import VerificationError
+from kyverno_tpu.engine.registry_verify import (
+    SIG_ANNOTATION,
+    RegistryClient,
+    RegistryVerifier,
+    dsse_pae,
+    parse_image_ref,
+)
+from kyverno_tpu.utils import ecdsa
+
+
+class RegistryStub:
+    """Docker Registry API v2 stub with cosign publishing helpers."""
+
+    def __init__(self, require_token: bool = False):
+        self.manifests = {}   # (repo, ref) -> bytes
+        self.blobs = {}       # (repo, digest) -> bytes
+        self.require_token = require_token
+        self.token = "stub-token-123"
+        self.requests = []
+        self.httpd = None
+
+    # ---------------------------------------------------------- publish
+
+    def put_blob(self, repo: str, data: bytes) -> str:
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        self.blobs[(repo, digest)] = data
+        return digest
+
+    def put_manifest(self, repo: str, ref: str, manifest: dict) -> str:
+        body = json.dumps(manifest).encode()
+        digest = "sha256:" + hashlib.sha256(body).hexdigest()
+        self.manifests[(repo, ref)] = body
+        self.manifests[(repo, digest)] = body
+        return digest
+
+    def push_image(self, repo: str, tag: str) -> str:
+        cfg = self.put_blob(repo, json.dumps(
+            {"architecture": "tpu", "repo": repo, "tag": tag}).encode())
+        return self.put_manifest(repo, tag, {
+            "schemaVersion": 2, "config": {"digest": cfg}, "layers": [],
+            "annotations": {"org.opencontainers.image.ref.name":
+                            f"{repo}:{tag}"}})
+
+    def cosign_sign(self, repo: str, digest: str, priv: int,
+                    bind_digest: str | None = None) -> None:
+        """Publish a cosign signature object for ``digest``."""
+        payload = json.dumps({
+            "critical": {
+                "identity": {"docker-reference": repo},
+                "image": {"docker-manifest-digest": bind_digest or digest},
+                "type": "cosign container image signature"},
+            "optional": None,
+        }).encode()
+        sig = base64.b64encode(ecdsa.sign(priv, payload)).decode()
+        blob_digest = self.put_blob(repo, payload)
+        tag = digest.replace("sha256:", "sha256-") + ".sig"
+        self.put_manifest(repo, tag, {
+            "schemaVersion": 2,
+            "layers": [{"digest": blob_digest,
+                        "size": len(payload),
+                        "annotations": {SIG_ANNOTATION: sig}}]})
+
+    def cosign_attest(self, repo: str, digest: str, priv: int,
+                      statement: dict, bind_subject: bool = True) -> None:
+        if bind_subject and "subject" not in statement:
+            statement = dict(statement, subject=[
+                {"name": repo,
+                 "digest": {"sha256": digest.split(":", 1)[-1]}}])
+        payload = json.dumps(statement).encode()
+        ptype = "application/vnd.in-toto+json"
+        sig = base64.b64encode(ecdsa.sign(priv, dsse_pae(ptype, payload)))
+        envelope = json.dumps({
+            "payloadType": ptype,
+            "payload": base64.b64encode(payload).decode(),
+            "signatures": [{"sig": sig.decode()}],
+        }).encode()
+        blob_digest = self.put_blob(repo, envelope)
+        tag = digest.replace("sha256:", "sha256-") + ".att"
+        manifest = json.loads(self.manifests.get(
+            (repo, tag), b'{"schemaVersion": 2, "layers": []}'))
+        manifest["layers"].append({"digest": blob_digest,
+                                   "size": len(envelope)})
+        self.put_manifest(repo, tag, manifest)
+
+    # ------------------------------------------------------------ serving
+
+    def start(self) -> str:
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body=b"", headers=()):
+                self.send_response(code)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                stub.requests.append(self.path)
+                if self.path.startswith("/token"):
+                    return self._reply(
+                        200, json.dumps({"token": stub.token}).encode())
+                if stub.require_token and \
+                        self.headers.get("Authorization") != \
+                        f"Bearer {stub.token}":
+                    port = self.server.server_address[1]
+                    return self._reply(401, b"{}", [(
+                        "WWW-Authenticate",
+                        f'Bearer realm="http://127.0.0.1:{port}/token",'
+                        f'service="stub",scope="pull"')])
+                parts = self.path.split("/")
+                # /v2/<repo...>/manifests/<ref> | /v2/<repo...>/blobs/<dg>
+                if len(parts) >= 5 and parts[1] == "v2":
+                    kind, ref = parts[-2], parts[-1]
+                    repo = "/".join(parts[2:-2])
+                    if kind == "manifests":
+                        body = stub.manifests.get((repo, ref))
+                        if body is not None:
+                            dg = "sha256:" + hashlib.sha256(body).hexdigest()
+                            return self._reply(
+                                200, body, [("Docker-Content-Digest", dg)])
+                    elif kind == "blobs":
+                        body = stub.blobs.get((repo, ref))
+                        if body is not None:
+                            return self._reply(200, body)
+                self._reply(404, b"{}")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        return f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+
+
+@pytest.fixture()
+def stub():
+    s = RegistryStub()
+    host = s.start()
+    yield s, host
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    priv, pub = ecdsa.generate_keypair()
+    return priv, ecdsa.public_key_to_pem(pub)
+
+
+def test_parse_image_ref():
+    # official images normalize to the library/ namespace on Docker Hub
+    assert parse_image_ref("nginx:1.21") == \
+        ("docker.io", "library/nginx", "1.21", "")
+    assert parse_image_ref("team/app:v1") == \
+        ("docker.io", "team/app", "v1", "")
+    assert parse_image_ref("ghcr.io/a/b:v2") == ("ghcr.io", "a/b", "v2", "")
+    assert parse_image_ref("localhost:5000/x/y") == \
+        ("localhost:5000", "x/y", "latest", "")
+    r = parse_image_ref("r.io/a@sha256:" + "0" * 64)
+    assert r[0] == "r.io" and r[3].startswith("sha256:")
+
+
+class TestSignatureVerification:
+    def _verifier(self, host):
+        return RegistryVerifier(RegistryClient(plain_http=True),
+                                default_registry=host)
+
+    def test_signed_image_verifies_and_returns_digest(self, stub, keypair):
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv)
+        out = self._verifier(host).verify_signature(
+            f"{host}/team/app:v1", key=pem)
+        assert out == digest
+
+    def test_unsigned_image_fails(self, stub, keypair):
+        s, host = stub
+        _, pem = keypair
+        s.push_image("team/app", "v1")
+        with pytest.raises(VerificationError, match="no cosign object"):
+            self._verifier(host).verify_signature(
+                f"{host}/team/app:v1", key=pem)
+
+    def test_wrong_key_fails(self, stub, keypair):
+        s, host = stub
+        priv, _ = keypair
+        other_pem = ecdsa.public_key_to_pem(ecdsa.generate_keypair()[1])
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv)
+        with pytest.raises(VerificationError, match="does not match key"):
+            self._verifier(host).verify_signature(
+                f"{host}/team/app:v1", key=other_pem)
+
+    def test_digest_binding_mismatch_fails(self, stub, keypair):
+        """A valid signature over a DIFFERENT digest must not transfer."""
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv,
+                      bind_digest="sha256:" + "ab" * 32)
+        with pytest.raises(VerificationError, match="binds"):
+            self._verifier(host).verify_signature(
+                f"{host}/team/app:v1", key=pem)
+
+    def test_repository_override(self, stub, keypair):
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("mirror/sigs", digest, priv)
+        out = self._verifier(host).verify_signature(
+            f"{host}/team/app:v1", key=pem,
+            repository=f"{host}/mirror/sigs")
+        assert out == digest
+
+    def test_cross_registry_repository_override(self, keypair):
+        """Signatures stored on a DIFFERENT registry than the image."""
+        priv, pem = keypair
+        img_stub, sig_stub = RegistryStub(), RegistryStub()
+        img_host, sig_host = img_stub.start(), sig_stub.start()
+        try:
+            digest = img_stub.push_image("team/app", "v1")
+            sig_stub.push_image("sigs/store", "seed")  # repo exists
+            sig_stub.cosign_sign("sigs/store", digest, priv)
+            out = RegistryVerifier(
+                RegistryClient(plain_http=True),
+                default_registry=img_host).verify_signature(
+                    f"{img_host}/team/app:v1", key=pem,
+                    repository=f"{sig_host}/sigs/store")
+            assert out == digest
+            # the signature fetch went to the OTHER registry
+            assert any("sigs/store" in p for p in sig_stub.requests)
+        finally:
+            img_stub.stop()
+            sig_stub.stop()
+
+    def test_verification_cache_skips_network(self, stub, keypair):
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv)
+        v = self._verifier(host)
+        assert v.verify_signature(f"{host}/team/app:v1", key=pem) == digest
+        before = len(s.requests)
+        assert v.verify_signature(f"{host}/team/app:v1", key=pem) == digest
+        assert len(s.requests) == before    # served from the TTL cache
+
+    def test_token_auth_flow(self, keypair):
+        s = RegistryStub(require_token=True)
+        host = s.start()
+        try:
+            priv, pem = keypair
+            digest = s.push_image("team/app", "v1")
+            s.cosign_sign("team/app", digest, priv)
+            out = RegistryVerifier(
+                RegistryClient(plain_http=True),
+                default_registry=host).verify_signature(
+                    f"{host}/team/app:v1", key=pem)
+            assert out == digest
+            assert any(p.startswith("/token") for p in s.requests)
+        finally:
+            s.stop()
+
+
+class TestAttestations:
+    def test_fetch_and_verify_statements(self, stub, keypair):
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        stmt = {"predicateType": "https://slsa.dev/provenance/v0.2",
+                "predicate": {"builder": {"id": "ci"}}}
+        s.cosign_attest("team/app", digest, priv, stmt)
+        out = RegistryVerifier(
+            RegistryClient(plain_http=True),
+            default_registry=host).fetch_attestations(
+                f"{host}/team/app:v1", key=pem)
+        assert len(out) == 1
+        assert out[0]["predicate"] == stmt["predicate"]
+        assert out[0]["subject"][0]["digest"]["sha256"] == \
+            digest.split(":", 1)[-1]
+
+    def test_replayed_attestation_rejected(self, stub, keypair):
+        """A key-valid attestation for image A republished under image B's
+        .att tag must not verify (subject digest binding)."""
+        s, host = stub
+        priv, pem = keypair
+        digest_a = s.push_image("team/app", "v1")
+        digest_b = s.push_image("team/other", "v1")
+        stmt = {"predicateType": "t", "predicate": {"ok": True},
+                "subject": [{"name": "team/app",
+                             "digest": {"sha256":
+                                        digest_a.split(":", 1)[-1]}}]}
+        # republish A's (validly signed) envelope under B's att tag
+        s.cosign_attest("team/other", digest_b, priv, stmt,
+                        bind_subject=False)
+        with pytest.raises(VerificationError, match="subject does not"):
+            RegistryVerifier(
+                RegistryClient(plain_http=True),
+                default_registry=host).fetch_attestations(
+                    f"{host}/team/other:v1", key=pem)
+
+    def test_bad_envelope_signature_fails(self, stub, keypair):
+        s, host = stub
+        priv, pem = keypair
+        other_priv, _ = ecdsa.generate_keypair()
+        digest = s.push_image("team/app", "v1")
+        s.cosign_attest("team/app", digest, other_priv,
+                        {"predicateType": "t", "predicate": {}})
+        with pytest.raises(VerificationError, match="attestation signature"):
+            RegistryVerifier(
+                RegistryClient(plain_http=True),
+                default_registry=host).fetch_attestations(
+                    f"{host}/team/app:v1", key=pem)
+
+
+class TestWebhookE2E:
+    """The VERDICT 'done' shape: registry stub + signed/unsigned image
+    -> digest patch vs block through the production HTTP webhook, and a
+    PolicyReport row either way."""
+
+    def _policy(self, host, pem):
+        return {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "verify-app-images"},
+            "spec": {
+                "validationFailureAction": "enforce",
+                "rules": [{
+                    "name": "check-sig",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "verifyImages": [{
+                        "image": f"{host}/team/*",
+                        "key": pem,
+                    }],
+                }],
+            },
+        }
+
+    def _post(self, port, resource):
+        review = {
+            "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": "u1", "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/mutate",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def test_signed_patches_unsigned_blocks_and_reports(self, stub, keypair):
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.server import Controller
+
+        s, host = stub
+        priv, pem = keypair
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv)
+        s.push_image("team/rogue", "v1")     # unsigned
+
+        cluster = FakeCluster([self._policy(host, pem)])
+        controller = Controller(
+            client=cluster, serve_port=0,
+            image_verifier=RegistryVerifier(RegistryClient(plain_http=True),
+                                            default_registry=host))
+        controller.start(host="127.0.0.1")
+        try:
+            port = controller._httpd.server_address[1]
+
+            def pod(name, image):
+                return {"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": name, "namespace": "default"},
+                        "spec": {"containers": [
+                            {"name": "c", "image": image}]}}
+
+            good = self._post(port, pod("good", f"{host}/team/app:v1"))
+            assert good["response"]["allowed"] is True
+            patch = json.loads(base64.b64decode(
+                good["response"]["patch"]))
+            assert any(p["value"].endswith("@" + digest) for p in patch)
+
+            bad = self._post(port, pod("bad", f"{host}/team/rogue:v1"))
+            assert bad["response"]["allowed"] is False
+            assert "image verification failed" in \
+                bad["response"]["status"]["message"]
+
+            # PolicyReport rows for both outcomes
+            reports = controller.report_gen.aggregate()
+            results = [r for rep in reports
+                       for r in rep.get("results", [])
+                       if rep.get("kind", "").endswith("PolicyReport")
+                       and r.get("policy") == "verify-app-images"]
+            statuses = {r.get("result") or r.get("status") for r in results}
+            assert "pass" in statuses and "fail" in statuses
+        finally:
+            controller.stop()
