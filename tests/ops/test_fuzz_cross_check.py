@@ -64,8 +64,8 @@ def rand_pattern(rng, depth=0):
     out = {}
     for _ in range(rng.randint(1, 3)):
         key = rng.choice(KEYS)
-        if rng.random() < 0.25:
-            kind = rng.choice(["(", "^(", "=(", "X("])
+        if rng.random() < 0.3:
+            kind = rng.choice(["(", "^(", "=(", "X(", "<("])
             key = f"{kind}{key})"
         out[key] = rand_pattern(rng, depth + 1)
     return out
@@ -94,19 +94,27 @@ def rand_policy(rng, i):
             "match": {"resources": {"kinds": [rng.choice(
                 ["Pod", "ConfigMap", "*"])]}}}
     r = rng.random()
-    if r < 0.4:
+    if r < 0.36:
         rule["validate"] = {"pattern": {"data": rand_pattern(rng)}}
-    elif r < 0.55:
+    elif r < 0.50:
         rule["validate"] = {"anyPattern": [
             {"data": rand_pattern(rng)}
             for _ in range(rng.randint(2, 3))]}
-    elif r < 0.75:
+    elif r < 0.68:
         rule["validate"] = {"deny": {"conditions": {
             rng.choice(["any", "all"]): [rand_condition(rng)
                                          for _ in range(rng.randint(1, 2))]}}}
-    else:
+    elif r < 0.92:
         rule["preconditions"] = {"all": [rand_condition(rng)]}
         rule["validate"] = {"pattern": {"data": rand_pattern(rng)}}
+    else:
+        # foreach rules are host-only in the device IR (ir.py "foreach
+        # rules"); the fuzz proves the compiler routes them to HOST and
+        # the oracle evaluates the generated shapes without divergence
+        rule["validate"] = {"foreach": [{
+            "list": "request.object.data.items",
+            "pattern": {"element": rand_pattern(rng)},
+        }]}
     if rng.random() < 0.3:
         rule["exclude"] = {"resources": {
             "names": [rng.choice(["cm-1*", "pod-?2", "x*"])]}}
@@ -126,21 +134,29 @@ def rand_value(rng, depth=0):
 
 
 def rand_resource(rng, i):
+    data = {rng.choice(KEYS): rand_value(rng)
+            for _ in range(rng.randint(0, 4))}
+    if rng.random() < 0.4:
+        data["items"] = [rand_value(rng, depth=1)
+                         for _ in range(rng.randint(0, 3))]
     return {
         "apiVersion": "v1",
         "kind": rng.choice(["Pod", "ConfigMap", "Secret"]),
         "metadata": {"name": f"{rng.choice(['pod', 'cm', 'x'])}-{i % 40}"},
-        "data": {rng.choice(KEYS): rand_value(rng)
-                 for _ in range(rng.randint(0, 4))},
+        "data": data,
     }
 
 
-@pytest.mark.parametrize("seed", list(range(1, 25)))
+@pytest.mark.parametrize("seed", list(range(1, 65)))
 def test_fuzz_device_matches_oracle(seed):
     rng = random.Random(20260730 + seed)
-    policies = [rand_policy(rng, i) for i in range(12)]
-    resources = [rand_resource(rng, i) for i in range(60)]
+    policies = [rand_policy(rng, i) for i in range(10)]
+    resources = [rand_resource(rng, i) for i in range(40)]
     cps = CompiledPolicySet(policies)
+    # compiler guard: every foreach rule must have taken the host lane
+    for r, ref in enumerate(cps.rule_refs):
+        if ref.rule.validation is not None and ref.rule.validation.foreach:
+            assert cps.tensors.rule_host_only[r], "foreach must be host-only"
     batch = cps.flatten(resources)
     device = np.asarray(cps.evaluate_device(batch))
     oracle = oracle_matrix(cps, resources)

@@ -1,0 +1,31 @@
+"""Host-lane ceiling on the bench library corpus.
+
+BENCH_r03 measured host_cell_pct 7.55 on the 250-policy library; without
+a CI ceiling a compiler regression could silently dump half the rule set
+to the CPU oracle and every throughput number would quietly collapse
+while tests stayed green. This pins both the cell-level and rule-level
+ceilings with headroom above the measured value."""
+
+import numpy as np
+
+from kyverno_tpu.models import CompiledPolicySet, Verdict
+
+
+def test_library_host_lane_ceiling():
+    from bench import _library_250, mixed_resource
+
+    cps = CompiledPolicySet(_library_250())
+    host_rules = int(cps.tensors.rule_host_only.sum())
+    n_rules = int(cps.tensors.n_rules)
+    # measured r03/r04: 42 of 286 rules host-only (context/variable rules)
+    assert host_rules / n_rules <= 0.20, (
+        f"{host_rules}/{n_rules} rules compile host-only — device coverage "
+        f"regressed")
+
+    resources = [mixed_resource(i) for i in range(512)]
+    verdicts = cps.evaluate_device(cps.flatten_packed(resources))
+    host_pct = 100 * float((np.asarray(verdicts) == Verdict.HOST).mean())
+    # measured 7.55% (BENCH_r03 config 3); ceiling leaves headroom for
+    # corpus drift but catches a systemic routing regression
+    assert host_pct <= 10.0, (
+        f"host_cell_pct {host_pct:.2f} exceeds the 10% ceiling")
