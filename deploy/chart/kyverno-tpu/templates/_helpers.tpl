@@ -1,0 +1,31 @@
+{{- define "kyverno-tpu.name" -}}
+{{ .Values.nameOverride | default .Chart.Name }}
+{{- end -}}
+
+{{- define "kyverno-tpu.fullname" -}}
+{{ .Values.fullnameOverride | default (include "kyverno-tpu.name" .) }}
+{{- end -}}
+
+{{- define "kyverno-tpu.namespace" -}}
+{{ .Values.namespace | default .Release.Namespace }}
+{{- end -}}
+
+{{- define "kyverno-tpu.serviceAccountName" -}}
+{{ .Values.serviceAccount.name | default (include "kyverno-tpu.fullname" .) }}
+{{- end -}}
+
+{{- define "kyverno-tpu.labels" -}}
+app: {{ include "kyverno-tpu.fullname" . }}
+app.kubernetes.io/name: {{ include "kyverno-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "kyverno-tpu.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "kyverno-tpu.initImage" -}}
+{{ .Values.initImage.repository | default .Values.image.repository }}:{{ .Values.initImage.tag | default (.Values.image.tag | default .Chart.AppVersion) }}
+{{- end -}}

@@ -6,7 +6,7 @@ import argparse
 import sys
 
 from .. import __version__
-from . import apply_cmd, test_cmd, validate_cmd
+from . import apply_cmd, chart_cmd, test_cmd, validate_cmd
 
 
 def main(argv=None) -> int:
@@ -19,6 +19,7 @@ def main(argv=None) -> int:
     apply_cmd.register(subparsers)
     test_cmd.register(subparsers)
     validate_cmd.register(subparsers)
+    chart_cmd.register(subparsers)
     # `version` verb parity (pkg/kyverno/version/command.go)
     version_p = subparsers.add_parser("version", help="print version")
     version_p.set_defaults(func=lambda _a: print(f"Version: {__version__}") or 0)

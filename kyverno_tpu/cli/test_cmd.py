@@ -26,6 +26,13 @@ def run(args) -> int:
     failures = 0
     ran = 0
     for test_dir in args.paths or ["."]:
+        if is_git_url(test_dir):
+            test_dir = clone_git_source(test_dir, args.git_branch)
+            if test_dir is None:
+                # a corpus the caller named but we couldn't fetch is a
+                # failure, not a silent skip — CI must go red
+                failures += 1
+                continue
         for test_file in _find_test_files(test_dir):
             ran += 1
             failures += run_test_file(test_file, verbose=not args.quiet)
@@ -33,6 +40,41 @@ def run(args) -> int:
         print("no test yamls available", file=sys.stderr)
         return 2
     return 1 if failures else 0
+
+
+def is_git_url(path: str) -> bool:
+    """Git sources the way the reference CLI takes them
+    (pkg/kyverno/test/git.go:14 — the public-policies regression replay
+    clones https URLs; file:// and .git paths work offline)."""
+    return (path.startswith(("https://", "http://", "git://", "ssh://",
+                             "file://"))
+            or path.endswith(".git"))
+
+
+def clone_git_source(url: str, branch: str = "") -> str | None:
+    """Shallow-clone a git test source into a temp dir; returns the
+    checkout path (cleaned up at process exit), or None on failure."""
+    import atexit
+    import shutil
+    import subprocess
+    import tempfile
+
+    dest = tempfile.mkdtemp(prefix="kyverno-test-git-")
+    atexit.register(shutil.rmtree, dest, ignore_errors=True)
+    cmd = ["git", "clone", "--depth", "1"]
+    if branch:
+        cmd += ["--branch", branch]
+    cmd += [url, dest]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except subprocess.CalledProcessError as e:
+        stderr = e.stderr.decode(errors="replace").strip()
+        print(f"failed to clone {url}: {stderr}", file=sys.stderr)
+        return None
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"failed to clone {url}: {e}", file=sys.stderr)
+        return None
+    return dest
 
 
 def _find_test_files(root: str) -> list[str]:
@@ -164,6 +206,10 @@ def _check_patched_resource(base, want, record) -> str:
 
 def register(subparsers) -> None:
     p = subparsers.add_parser("test", help="run declarative policy tests")
-    p.add_argument("paths", nargs="*", help="dirs containing test.yaml")
+    p.add_argument("paths", nargs="*",
+                   help="dirs containing test.yaml, or git URLs to clone "
+                        "(https://..., file://..., *.git)")
+    p.add_argument("-b", "--git-branch", default="",
+                   help="branch to clone when a path is a git URL")
     p.add_argument("-q", "--quiet", action="store_true")
     p.set_defaults(func=run)

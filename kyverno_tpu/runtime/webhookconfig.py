@@ -75,12 +75,39 @@ class Register:
     def __init__(self, client, ca_bundle: str = "",
                  service_namespace: str = "kyverno",
                  service_name: str = "kyverno-svc",
-                 timeout_s: int = DEFAULT_WEBHOOK_TIMEOUT_S):
+                 timeout_s: int = 0,
+                 default_failure_policy: str = ""):
+        import os
+
         self.client = client
         self.ca_bundle = ca_bundle
         self.service_namespace = service_namespace
         self.service_name = service_name
-        self.timeout_s = timeout_s
+        # deployment knobs (Helm webhooks.* -> env). Validated here: a
+        # malformed value must degrade to the safe default with a warning,
+        # not crash-loop the controller or register an API-invalid config
+        import logging
+
+        log = logging.getLogger("kyverno.webhookconfig")
+        if not timeout_s:
+            raw = os.environ.get("KTPU_WEBHOOK_TIMEOUT_S", "")
+            try:
+                timeout_s = int(raw) if raw else DEFAULT_WEBHOOK_TIMEOUT_S
+            except ValueError:
+                log.warning("invalid KTPU_WEBHOOK_TIMEOUT_S=%r; using %ss",
+                            raw, DEFAULT_WEBHOOK_TIMEOUT_S)
+                timeout_s = DEFAULT_WEBHOOK_TIMEOUT_S
+        # admissionregistration accepts 1..30 only
+        self.timeout_s = min(30, max(1, timeout_s))
+        # the catch-all resource webhooks default to Ignore like the
+        # reference's; Fail closes the cluster on controller outage
+        fp = (default_failure_policy
+              or os.environ.get("KTPU_DEFAULT_FAILURE_POLICY", "")
+              or "Ignore").capitalize()
+        if fp not in ("Ignore", "Fail"):
+            log.warning("invalid failurePolicy %r; using Ignore", fp)
+            fp = "Ignore"
+        self.default_failure_policy = fp
 
     def _configs(self) -> list[dict]:
         mk = _webhook_config
@@ -89,9 +116,11 @@ class Register:
                     service_name=self.service_name, timeout_s=self.timeout_s)
         return [
             mk("MutatingWebhookConfiguration", MUTATING_WEBHOOK_CONFIG,
-               "/mutate", _ALL_RESOURCES_RULE, failure_policy="Ignore", **args),
+               "/mutate", _ALL_RESOURCES_RULE,
+               failure_policy=self.default_failure_policy, **args),
             mk("ValidatingWebhookConfiguration", VALIDATING_WEBHOOK_CONFIG,
-               "/validate", _ALL_RESOURCES_RULE, failure_policy="Ignore", **args),
+               "/validate", _ALL_RESOURCES_RULE,
+               failure_policy=self.default_failure_policy, **args),
             mk("ValidatingWebhookConfiguration", POLICY_VALIDATING_WEBHOOK_CONFIG,
                "/policyvalidate", _POLICY_RULE, **args),
             mk("MutatingWebhookConfiguration", POLICY_MUTATING_WEBHOOK_CONFIG,

@@ -1,0 +1,153 @@
+"""Deploy chart rendering (deploy/chart/kyverno-tpu via utils.helmlite)
+and git-URL sources for `cli test`.
+
+The chart must render the same object set as deploy/install.yaml (the
+reference ships charts/kyverno as its real install path; install.yaml is
+the kustomize fallback), and values must actually steer the output. The
+git-source test builds a local repo and replays a test.yaml corpus from a
+file:// clone — the offline shape of the reference's public-policies
+regression (pkg/kyverno/test/git.go:14, Makefile:245-249)."""
+
+import pathlib
+import subprocess
+
+import yaml
+
+from kyverno_tpu.cli.__main__ import main as cli_main
+from kyverno_tpu.utils.helmlite import render_chart
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+CHART = REPO / "deploy" / "chart" / "kyverno-tpu"
+
+
+def _by_kind(docs):
+    out = {}
+    for doc in docs:
+        out.setdefault(doc["kind"], []).append(doc)
+    return out
+
+
+class TestChartRendering:
+    def test_renders_same_object_set_as_install_yaml(self):
+        chart_docs = render_chart(CHART)
+        install_docs = [d for d in yaml.safe_load_all(
+            (REPO / "deploy" / "install.yaml").read_text()) if d]
+        chart_kinds = {(d["kind"], d["metadata"]["name"])
+                       for d in chart_docs}
+        install_kinds = {(d["kind"], d["metadata"]["name"])
+                         for d in install_docs}
+        assert install_kinds <= chart_kinds, (
+            f"missing from chart: {install_kinds - chart_kinds}")
+
+    def test_deployment_defaults_match_install_yaml(self):
+        dep = _by_kind(render_chart(CHART))["Deployment"][0]
+        install_dep = [d for d in yaml.safe_load_all(
+            (REPO / "deploy" / "install.yaml").read_text())
+            if d and d["kind"] == "Deployment"][0]
+        spec = dep["spec"]["template"]["spec"]
+        want = install_dep["spec"]["template"]["spec"]
+        assert dep["spec"]["replicas"] == install_dep["spec"]["replicas"]
+        assert spec["containers"][0]["command"] == \
+            want["containers"][0]["command"]
+        assert spec["initContainers"][0]["command"] == \
+            want["initContainers"][0]["command"]
+        assert spec["containers"][0]["resources"] == \
+            want["containers"][0]["resources"]
+        assert spec["containers"][0]["livenessProbe"] == \
+            want["containers"][0]["livenessProbe"]
+
+    def test_values_steer_output(self):
+        docs = render_chart(CHART, set_args=[
+            "replicaCount=3", "image.repository=gcr.io/x/ktpu",
+            "image.tag=v7", "webhooks.failurePolicy=Fail",
+            "webhooks.timeoutSeconds=30", "createNamespace=false",
+            "metricsService.create=false",
+            "podLabels.team=platform",
+        ])
+        kinds = _by_kind(docs)
+        assert "Namespace" not in kinds
+        assert len(kinds["Service"]) == 1          # metrics service gone
+        dep = kinds["Deployment"][0]
+        assert dep["spec"]["replicas"] == 3
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        assert container["image"] == "gcr.io/x/ktpu:v7"
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["KTPU_DEFAULT_FAILURE_POLICY"] == "Fail"
+        assert env["KTPU_WEBHOOK_TIMEOUT_S"] == "30"
+        assert dep["spec"]["template"]["metadata"]["labels"]["team"] == \
+            "platform"
+
+    def test_rbac_covers_controller_api_groups(self):
+        role = _by_kind(render_chart(CHART))["ClusterRole"][0]
+        groups = {g for rule in role["rules"]
+                  for g in rule.get("apiGroups", [])}
+        for needed in ("kyverno.io", "wgpolicyk8s.io",
+                       "admissionregistration.k8s.io",
+                       "apiextensions.k8s.io", "coordination.k8s.io"):
+            assert needed in groups, needed
+
+    def test_cli_render_chart_command(self, capsys):
+        rc = cli_main(["render-chart", str(CHART), "--set",
+                       "replicaCount=2"])
+        assert rc == 0
+        docs = [d for d in yaml.safe_load_all(capsys.readouterr().out) if d]
+        dep = [d for d in docs if d["kind"] == "Deployment"][0]
+        assert dep["spec"]["replicas"] == 2
+
+
+class TestGitTestSources:
+    def _make_repo(self, tmp_path) -> str:
+        src = tmp_path / "corpus"
+        case = src / "cases" / "latest"
+        case.mkdir(parents=True)
+        (case / "policy.yaml").write_text(yaml.safe_dump({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "disallow-latest"},
+            "spec": {"rules": [{
+                "name": "no-latest",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"pattern": {"spec": {"containers": [
+                    {"image": "!*:latest"}]}}},
+            }]}}))
+        (case / "resources.yaml").write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "bad"},
+            "spec": {"containers": [{"name": "c",
+                                     "image": "nginx:latest"}]}}))
+        (case / "test.yaml").write_text(yaml.safe_dump({
+            "name": "git-sourced",
+            "policies": ["policy.yaml"],
+            "resources": ["resources.yaml"],
+            "results": [{"policy": "disallow-latest", "rule": "no-latest",
+                         "resource": "bad", "status": "fail"}]}))
+        subprocess.run(["git", "init", "-q", "-b", "main", str(src)],
+                       check=True)
+        subprocess.run(["git", "-C", str(src), "add", "-A"], check=True)
+        subprocess.run(
+            ["git", "-C", str(src), "-c", "user.email=t@t",
+             "-c", "user.name=t", "commit", "-qm", "corpus"], check=True)
+        return f"file://{src}"
+
+    def test_cli_test_runs_from_git_url(self, tmp_path, capsys):
+        url = self._make_repo(tmp_path)
+        rc = cli_main(["test", url, "-b", "main"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1/1 passed" in out
+
+    def test_unreachable_git_url_reports_cleanly(self, tmp_path, capsys):
+        rc = cli_main(["test", f"file://{tmp_path}/nope.git"])
+        assert rc == 2          # no test yamls -> distinct exit code
+        err = capsys.readouterr().err
+        assert "failed to clone" in err
+
+    def test_failed_clone_fails_run_even_with_passing_local_tests(
+            self, tmp_path, capsys):
+        """A named-but-unfetchable corpus must go red, not silently skip
+        while local tests keep the exit code green."""
+        local = self._make_repo(tmp_path)[len("file://"):]
+        rc = cli_main(["test", local, f"file://{tmp_path}/nope.git"])
+        out = capsys.readouterr()
+        assert "1/1 passed" in out.out      # local corpus ran and passed
+        assert "failed to clone" in out.err
+        assert rc == 1                      # but the run still fails
