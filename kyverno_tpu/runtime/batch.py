@@ -62,9 +62,12 @@ def verdict_to_status(verdict: Verdict):
 
 
 class _Bucket:
+    _seq = __import__("itertools").count()
+
     def __init__(self, cps):
         self.cps = cps
         self.items: list[tuple[dict, Future]] = []
+        self.seq = next(self._seq)    # stable identity (id() gets reused)
 
 
 class AdmissionBatcher:
@@ -76,7 +79,9 @@ class AdmissionBatcher:
                  oracle_cost_init_s: float = 0.002,
                  dispatch_cost_init_s: float = 0.150,
                  probe_interval_s: float = 10.0,
-                 cold_flush_fallback: bool = True):
+                 cold_flush_fallback: bool = True,
+                 circuit_timeout_threshold: int = 3,
+                 circuit_cooldown_s: float = 5.0):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
@@ -104,6 +109,20 @@ class AdmissionBatcher:
         # that actually formed, not over the instantaneous concurrency
         self._batch_size_ema = 4.0
         self._last_dispatch = 0.0
+        # screen-timeout circuit breaker: consecutive *flushes* whose
+        # waiters gave up are direct evidence the device lane is slower
+        # than the model thinks (queue depth, tunnel stall); the breaker
+        # routes everything to the oracle for a cooldown instead of
+        # letting new requests pile onto a lane that is already failing
+        # its own deadline. Counted per flush — one slow dispatch strands
+        # all its waiters but is one event, not len(waiters) events — and
+        # cold-compile waits are excluded like _flush excludes them from
+        # the dispatch EMA.
+        self._consecutive_timeouts = 0
+        self._timed_out_flushes: set[int] = set()
+        self._circuit_open_until = 0.0
+        self.circuit_timeout_threshold = circuit_timeout_threshold
+        self.circuit_cooldown_s = circuit_cooldown_s
         self.stats = {"oracle": 0, "device": 0, "probe": 0,
                       "clean": 0, "attention": 0}
         # per-CompiledPolicySet shape buckets already compiled; weak keys
@@ -235,6 +254,9 @@ class AdmissionBatcher:
         with self._lock:
             if self._stopped:
                 return ATTENTION, []
+            if now < self._circuit_open_until:
+                self.stats["oracle"] += 1
+                return ORACLE, []
             self._arrivals.append(now)
             while self._arrivals and now - self._arrivals[0] > self.rate_window_s:
                 self._arrivals.popleft()
@@ -280,18 +302,43 @@ class AdmissionBatcher:
             # instead of eating the full deadline budget. Cold sets keep
             # the full budget — their first flush legitimately pays XLA
             # compilation
-            if self._seen_shapes.get(cps):
+            adaptive = bool(self._seen_shapes.get(cps))
+            if adaptive:
                 timeout_s = min(timeout_s,
                                 max(0.05, 4 * self._dispatch_cost
                                     + self.window_s))
+        wait_start = time.monotonic()
         try:
             status, row = fut.result(timeout=timeout_s)
         except Exception:
+            elapsed = time.monotonic() - wait_start
             with self._lock:
                 self.stats["screen_timeout"] = (
                     self.stats.get("screen_timeout", 0) + 1)
+                # cold shapes waited on XLA compilation — a one-time cost
+                # the EMA and breaker must not treat as lane slowness
+                # (mirrors _flush's cold exclusion)
+                if adaptive:
+                    # the wait itself is a dispatch-cost measurement the
+                    # EMA must not ignore: the lane was at LEAST this slow
+                    self._dispatch_cost = max(self._dispatch_cost, elapsed)
+                    if bucket.seq not in self._timed_out_flushes:
+                        self._timed_out_flushes.add(bucket.seq)
+                        if len(self._timed_out_flushes) > 64:
+                            self._timed_out_flushes.clear()
+                        self._consecutive_timeouts += 1
+                    now2 = time.monotonic()
+                    if (self._consecutive_timeouts
+                            >= self.circuit_timeout_threshold
+                            and now2 >= self._circuit_open_until):
+                        self._circuit_open_until = (
+                            now2 + self.circuit_cooldown_s)
+                        self.stats["circuit_open"] = (
+                            self.stats.get("circuit_open", 0) + 1)
             return ATTENTION, []
         with self._lock:
+            self._consecutive_timeouts = 0
+            self._timed_out_flushes.clear()
             self.stats["clean" if status == CLEAN else "attention"] += 1
         return status, row
 

@@ -355,3 +355,38 @@ class TestWebhookScreenPath:
             assert batcher.stats["oracle"] >= 2
         finally:
             batcher.stop()
+
+
+class TestCircuitBreaker:
+    def test_screen_timeouts_open_circuit_and_inflate_cost(self):
+        """Consecutive screen timeouts must (a) feed the dispatch-cost EMA
+        the measured wait and (b) open the breaker so later requests take
+        the oracle immediately instead of joining a failing lane."""
+        import time
+
+        from kyverno_tpu.runtime.batch import ORACLE
+
+        batcher, _ = make_batcher(dispatch_cost_init_s=0.001)
+        batcher.circuit_cooldown_s = 30.0
+        cps = batcher.policy_cache.compiled(
+            PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        # mark the shape warm so the adaptive (short) timeout applies,
+        # and make every flush hang past it
+        batcher._seen_shapes[cps] = {(1, 1, 1)}
+        batcher._flush = lambda *a, **k: time.sleep(0.4)
+        try:
+            with batcher.admission_in_flight(), batcher.admission_in_flight():
+                for _ in range(batcher.circuit_timeout_threshold):
+                    batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", pod("nginx:1.21"),
+                                   timeout_s=0.05)
+            assert batcher.stats.get("screen_timeout", 0) >= 3
+            assert batcher._dispatch_cost >= 0.05
+            assert batcher.stats.get("circuit_open", 0) >= 1
+            # breaker open: the next request routes to the oracle without
+            # enqueueing anything
+            status, _ = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                       "default", pod("nginx:1.21"))
+            assert status == ORACLE
+        finally:
+            batcher.stop()
