@@ -29,6 +29,7 @@ bounded by a deadline budget derived from the admission webhook timeout
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
 from collections import deque
@@ -62,7 +63,7 @@ def verdict_to_status(verdict: Verdict):
 
 
 class _Bucket:
-    _seq = __import__("itertools").count()
+    _seq = itertools.count()
 
     def __init__(self, cps):
         self.cps = cps
@@ -309,7 +310,7 @@ class AdmissionBatcher:
                                     + self.window_s))
         wait_start = time.monotonic()
         try:
-            status, row = fut.result(timeout=timeout_s)
+            status, row, device_answered = fut.result(timeout=timeout_s)
         except Exception:
             elapsed = time.monotonic() - wait_start
             with self._lock:
@@ -323,9 +324,9 @@ class AdmissionBatcher:
                     # EMA must not ignore: the lane was at LEAST this slow
                     self._dispatch_cost = max(self._dispatch_cost, elapsed)
                     if bucket.seq not in self._timed_out_flushes:
-                        self._timed_out_flushes.add(bucket.seq)
-                        if len(self._timed_out_flushes) > 64:
+                        if len(self._timed_out_flushes) >= 64:
                             self._timed_out_flushes.clear()
+                        self._timed_out_flushes.add(bucket.seq)
                         self._consecutive_timeouts += 1
                     now2 = time.monotonic()
                     if (self._consecutive_timeouts
@@ -337,8 +338,11 @@ class AdmissionBatcher:
                             self.stats.get("circuit_open", 0) + 1)
             return ATTENTION, []
         with self._lock:
-            self._consecutive_timeouts = 0
-            self._timed_out_flushes.clear()
+            if device_answered:
+                # only a flush the device actually served proves the lane
+                # healthy; cold-fallback and error resolutions do not
+                self._consecutive_timeouts = 0
+                self._timed_out_flushes.clear()
             self.stats["clean" if status == CLEAN else "attention"] += 1
         return status, row
 
@@ -353,7 +357,7 @@ class AdmissionBatcher:
                 if self._stopped:
                     for b in self._buckets.values():
                         for _, fut in b.items:
-                            fut.set_result((ATTENTION, []))
+                            fut.set_result((ATTENTION, [], False))
                     return
             # micro-batch window: let concurrent requests pile in
             time.sleep(self.window_s)
@@ -393,7 +397,8 @@ class AdmissionBatcher:
                 # bucket in the background for the next burst
                 for _, fut in items:
                     if not fut.done():
-                        fut.set_result((ATTENTION, []))
+                        # cold-fallback release: the device did NOT answer
+                        fut.set_result((ATTENTION, [], False))
             verdicts = np.asarray(cps.evaluate_device(batch))
             dt = time.monotonic() - t0
             with self._lock:
@@ -425,11 +430,11 @@ class AdmissionBatcher:
                     if v not in (Verdict.PASS, Verdict.SKIP):
                         clean = False
                 if not fut.done():
-                    fut.set_result((CLEAN if clean else ATTENTION, row))
+                    fut.set_result((CLEAN if clean else ATTENTION, row, True))
         except Exception:
             for _, fut in items:
                 if not fut.done():
-                    fut.set_result((ATTENTION, []))
+                    fut.set_result((ATTENTION, [], False))
 
     def stop(self) -> None:
         with self._lock:

@@ -1,0 +1,264 @@
+"""Systematic race harness over the controller's shared state.
+
+The reference leans on Go's race detector in CI (SURVEY.md section 5);
+CPython has no TSan, so this is the systematic analogue: a reusable
+harness that releases N threads through a barrier into mixed read/write
+workloads against one shared component, collects every exception, joins
+with a deadlock timeout, and then checks the component's invariants.
+Races in CPython manifest as exceptions (dict mutated during iteration,
+KeyError on check-then-act), torn/stale aggregates, or deadlocks — all
+three are what the harness asserts against. Each scenario pins a pairing
+that actually runs concurrently in the controller.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+
+
+def race(workers, duration_s: float = 1.0, join_timeout_s: float = 15.0):
+    """Run each callable in ``workers`` in a loop for ``duration_s``,
+    all released simultaneously. Returns the list of exceptions raised
+    (empty = clean run); fails the test on deadlock."""
+    barrier = threading.Barrier(len(workers))
+    stop = time.monotonic() + duration_s
+    errors: list[BaseException] = []
+    lock = threading.Lock()
+
+    def runner(fn):
+        barrier.wait()
+        i = 0
+        while time.monotonic() < stop:
+            try:
+                fn(i)
+            except BaseException as e:  # noqa: BLE001 - harness collects all
+                with lock:
+                    errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=runner, args=(fn,), daemon=True)
+               for fn in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(join_timeout_s)
+    assert not any(t.is_alive() for t in threads), "deadlock: thread stuck"
+    return errors
+
+
+def _policy(name, image_pat="!*:latest"):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"pattern": {"spec": {"containers": [
+                {"image": image_pat}]}}},
+        }]},
+    })
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": "nginx:1.21"}]}}
+
+
+class TestPolicyCacheRaces:
+    def test_reload_during_compiled_lookups(self):
+        """The controller recompiles tensors on policy change while the
+        webhook resolves compiled() for in-flight admissions."""
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        cache = PolicyCache()
+        cache.add(_policy("base"))
+
+        def admit(i):
+            cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                 "default")
+            assert cps is not None
+            # compiled sets must always be internally consistent
+            assert len(cps.rule_refs) == int(cps.tensors.n_rules)
+
+        def churn(i):
+            p = _policy(f"churn-{i % 4}")
+            cache.add(p)
+            cache.remove(p)
+
+        errors = race([admit, admit, churn, churn], duration_s=1.5)
+        assert not errors, errors[:3]
+
+
+class TestAdmissionBatcherRaces:
+    def test_screens_against_policy_churn_and_stop(self):
+        """Concurrent screens race the flush worker, the policy cache
+        generation change, and a late stop()."""
+        from kyverno_tpu.runtime.batch import AdmissionBatcher
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        cache = PolicyCache()
+        cache.add(_policy("base"))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False)
+
+        def screen(i):
+            with batcher.admission_in_flight():
+                status, row = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default", _pod(i),
+                    timeout_s=5.0)
+            assert status in ("clean", "attention", "oracle")
+
+        def churn(i):
+            p = _policy(f"extra-{i % 3}", image_pat="!*:dev")
+            cache.add(p)
+            time.sleep(0.002)
+            cache.remove(p)
+
+        try:
+            errors = race([screen, screen, screen, churn], duration_s=1.5)
+            assert not errors, errors[:3]
+        finally:
+            batcher.stop()
+        # stopped batcher answers instead of hanging
+        status, _ = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", _pod(0), timeout_s=1.0)
+        assert status == "attention"
+
+
+class TestResourceCacheRaces:
+    def test_gets_vs_watch_events_vs_invalidate(self):
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.resourcecache import ResourceCache
+
+        cluster = FakeCluster([{
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "prod", "labels": {"env": "prod"}}}])
+        cache = ResourceCache(cluster)
+
+        def reader(i):
+            labels = cache.get_namespace_labels("prod")
+            # a watch-maintained entry is some complete published state —
+            # exactly the key set a writer produced with a well-formed
+            # value — never a torn/partial dict (invalidate may yield {})
+            assert labels == {} or set(labels) == {"env"}, labels
+            if labels:
+                v = labels["env"]
+                assert v == "prod" or (
+                    v.startswith("v") and v[1:].isdigit()), labels
+
+        def writer(i):
+            ns = cluster.get_resource("v1", "Namespace", "", "prod")
+            ns["metadata"]["labels"] = {"env": f"v{i % 5}"}
+            cluster.update_resource(ns)
+
+        def invalidator(i):
+            cache.invalidate("Namespace", "", "prod")
+
+        errors = race([reader, reader, writer, invalidator], duration_s=1.5)
+        assert not errors, errors[:3]
+
+
+class TestWatchHubRaces:
+    def test_concurrent_ensure_shares_one_reflector(self):
+        """Many consumers ensuring the same GVK must converge on one
+        reflector and every callback must survive concurrent fan-out."""
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.watch import WatchHub
+
+        class ListingFake(FakeCluster):
+            def list_response(self, api_version, kind, namespace=""):
+                return {"items": self.list_resource(api_version, kind,
+                                                    namespace),
+                        "metadata": {"resourceVersion": "1"}}
+
+            def watch_stream(self, *a, stop=None, **kw):
+                # quiet stream: yields nothing, ends after a beat
+                time.sleep(0.01)
+                return iter(())
+
+        hub = WatchHub(ListingFake())
+        seen = []
+
+        def ensure(i):
+            refl = hub.ensure("v1", "ConfigMap",
+                              on_sync=lambda items: seen.append(len(items)))
+            assert refl.wait_synced(5.0)
+
+        try:
+            errors = race([ensure] * 6, duration_s=1.0)
+            assert not errors, errors[:3]
+            with hub._lock:
+                assert len(hub._reflectors) == 1
+        finally:
+            hub.stop()
+
+
+class TestReportPipelineRaces:
+    def test_concurrent_add_and_aggregate(self):
+        from kyverno_tpu.engine.response import (
+            EngineResponse,
+            PolicyResponse,
+            PolicySpecSummary,
+            ResourceSpec,
+            RuleResponse,
+            RuleStatus,
+            RuleType,
+        )
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.reports import ReportGenerator
+
+        gen = ReportGenerator(FakeCluster())
+
+        def add(i):
+            resp = EngineResponse(policy_response=PolicyResponse(
+                policy=PolicySpecSummary(name=f"pol-{i % 3}"),
+                resource=ResourceSpec(kind="Pod", namespace="default",
+                                      name=f"p{i % 7}")))
+            resp.policy_response.rules.append(RuleResponse(
+                name="r", type=RuleType.VALIDATION,
+                status=RuleStatus.PASS if i % 2 else RuleStatus.FAIL))
+            gen.add(resp)
+
+        def aggregate(i):
+            for report in gen.aggregate():
+                summary = report.get("summary") or {}
+                results = report.get("results") or []
+                # aggregate must be self-consistent, not torn
+                assert summary.get("pass", 0) + summary.get("fail", 0) + \
+                    summary.get("skip", 0) + summary.get("error", 0) + \
+                    summary.get("warn", 0) == len(results)
+
+        errors = race([add, add, aggregate], duration_s=1.5)
+        assert not errors, errors[:3]
+
+
+class TestDeviceScreenRaces:
+    def test_concurrent_packed_eval_same_compiled_set(self):
+        """Multiple flush threads sharing one CompiledPolicySet must get
+        identical verdicts for identical inputs (jit cache, flattener
+        context, and blob cache are shared state)."""
+        from kyverno_tpu.models import CompiledPolicySet
+
+        cps = CompiledPolicySet([_policy("p1"), _policy("p2", "!*:dev")])
+        pods = [_pod(i) for i in range(16)]
+        want = cps.evaluate_device(cps.flatten_packed(pods))
+        results = []
+        lock = threading.Lock()
+
+        def evaluate(i):
+            got = cps.evaluate_device(cps.flatten_packed(pods))
+            with lock:
+                results.append(got)
+
+        errors = race([evaluate] * 4, duration_s=1.5)
+        assert not errors, errors[:3]
+        for got in results:
+            assert np.array_equal(got, want)
