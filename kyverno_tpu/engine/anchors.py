@@ -14,6 +14,7 @@ Kinds (cf. /root/reference/pkg/engine/anchor/common/common.go):
 from __future__ import annotations
 
 from enum import Enum
+from functools import lru_cache
 
 
 class Anchor(Enum):
@@ -58,8 +59,11 @@ def is_non_anchor(key: str) -> bool:
     return anchor_kind(key) is Anchor.NONE
 
 
+@lru_cache(maxsize=4096)
 def anchor_kind(key: str) -> Anchor:
-    # two-char prefixes take precedence over the bare "(...)" form
+    # two-char prefixes take precedence over the bare "(...)" form; a pure
+    # function of the key string, and the oracle asks it ~56k times per
+    # library-corpus admission over a small recurring key set — memoized
     if is_global_anchor(key):
         return Anchor.GLOBAL
     if is_existence_anchor(key):

@@ -1,0 +1,286 @@
+"""Multiprocess CPU-oracle lane: multicore scaling for admission bursts.
+
+The reference gets a goroutine per admission request and all host cores
+for free (pkg/webhooks/server.go:233); CPython's GIL serializes our
+oracle, so a 16-way burst on an 8-core host still evaluates one policy
+at a time. This pool runs the per-request enforce loop in *spawned*
+worker processes (spawn, never fork: the parent holds initialized
+TPU/JAX state that must not leak into children; workers import only the
+jax-free engine modules).
+
+Scope is deliberately narrow and safe:
+
+- engages only when the host has enough cores to win
+  (``os.cpu_count() >= MIN_CORES``) — on the 1-core sandbox it stays
+  dormant and the inline path is untouched;
+- only *cluster-independent* policies are eligible (no ``context:``
+  entries, no API calls): workers have no cluster client, so anything
+  needing one stays inline. Namespace labels and RBAC roles resolve in
+  the parent and travel as plain data;
+- any pool failure — pickling, worker crash, timeout — falls back to
+  the inline oracle for that request. Wrong-way cost is latency only.
+
+Policy sets ship to workers once per generation via the pool
+initializer; a policy-cache change rebuilds the pool in the background
+(policy updates are rare; admission keeps the old pool until the new
+one is warm).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import threading
+from concurrent.futures import ProcessPoolExecutor
+
+MIN_CORES = 4
+
+# worker-side state (one policy set per generation)
+_worker_policies: list = []
+
+
+def _worker_init(policy_raws: list[dict]) -> None:
+    global _worker_policies
+    # keep any accidental jax import CPU-only inside workers (the oracle
+    # path never imports jax; this is a backstop, not a dependency)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from ..api.load import load_policy
+
+    _worker_policies = [load_policy(raw) for raw in policy_raws]
+
+
+# env vars that make a freshly spawned interpreter claim accelerator
+# state (the sandbox's sitecustomize registers a TPU PJRT backend when it
+# sees these). Workers are pure-CPU oracle processes: they must never
+# race the parent for the chip. The scrub happens in a per-worker
+# launcher script — NOT by mutating the parent's os.environ, which other
+# threads (e.g. a first jax backend init on the admission path) could
+# read mid-window.
+_ACCEL_ENV = ("PALLAS_AXON_POOL_IPS",)
+
+
+def _make_worker_launcher() -> str:
+    """Write a launcher that scrubs accelerator env and execs the real
+    interpreter; ``multiprocessing.set_executable`` points spawns at it."""
+    import stat
+    import sys
+    import tempfile
+
+    lines = ["#!/bin/sh", "export JAX_PLATFORMS=cpu"]
+    lines += [f"unset {key}" for key in _ACCEL_ENV]
+    lines.append(f'exec "{sys.executable}" "$@"')
+    fd, path = tempfile.mkstemp(prefix="ktpu-oracle-worker-", suffix=".sh")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR)
+    return path
+
+
+def _worker_evaluate(names: list[str], resource: dict, request: dict,
+                     ns_labels: dict, roles: list, cluster_roles: list,
+                     exclude_group_role: list):
+    """Run the enforce oracle for the named policies in this worker.
+    Returns [(policy_name, [(rule_name, status_value, message), ...])]."""
+    from ..engine.context import Context
+    from ..engine.match import AdmissionUserInfo, RequestInfo
+    from ..engine.policy_context import PolicyContext
+    from ..engine.validation import validate as oracle_validate
+
+    ctx = Context()
+    ctx.add_request(request)
+    if resource:
+        ctx.add_resource(resource)
+    if request.get("oldObject"):
+        ctx.add_old_resource(request["oldObject"])
+    user_info = request.get("userInfo") or {}
+    ctx.add_user_info({"roles": roles, "clusterRoles": cluster_roles,
+                       "userInfo": user_info})
+    username = user_info.get("username", "")
+    if username:
+        ctx.add_service_account(username)
+    try:
+        ctx.add_image_info(resource)
+    except Exception:
+        pass
+
+    wanted = set(names)
+    pctx = PolicyContext(
+        new_resource=resource,
+        old_resource=request.get("oldObject") or {},
+        json_context=ctx, namespace_labels=ns_labels,
+        exclude_group_role=exclude_group_role,
+        admission_info=RequestInfo(
+            roles=roles, cluster_roles=cluster_roles,
+            admission_user_info=AdmissionUserInfo(
+                username=username, uid=user_info.get("uid", ""),
+                groups=user_info.get("groups") or [])),
+    )
+    out = []
+    for policy in _worker_policies:
+        if policy.name not in wanted:
+            continue
+        pctx.policy = policy
+        resp = oracle_validate(pctx)
+        out.append((policy.name,
+                    [(r.name, r.status.value, r.message)
+                     for r in resp.policy_response.rules]))
+    return out
+
+
+def pool_safe(policy) -> bool:
+    """True when every rule of the policy evaluates without a cluster
+    client: no context entries (ConfigMap/APICall loads)."""
+    for rule in policy.spec.rules:
+        if rule.context:
+            return False
+    return True
+
+
+class OraclePool:
+    """Process pool over the current enforce policy set."""
+
+    def __init__(self, workers: int | None = None,
+                 min_cores: int = MIN_CORES,
+                 miss_threshold: int = 3, miss_cooldown_s: float = 30.0):
+        cores = os.cpu_count() or 1
+        self.enabled = cores >= min_cores
+        self.workers = workers or max(2, min(8, cores - 1))
+        self._pool: ProcessPoolExecutor | None = None
+        self._generation = -1
+        self._building: int | None = None
+        self._lock = threading.Lock()
+        self._ctx = multiprocessing.get_context("spawn")
+        self._launcher: str | None = None
+        self.hits = 0
+        self.misses = 0
+        # lane breaker: consecutive timeouts/errors take the lane out for
+        # a cooldown instead of adding a flat timeout to every admission
+        self.miss_threshold = miss_threshold
+        self.miss_cooldown_s = miss_cooldown_s
+        self._consecutive_misses = 0
+        self._disabled_until = 0.0
+        # backlog guard: abandoned (timed-out) tasks keep running in the
+        # workers; don't queue more than the pool can plausibly drain
+        self._inflight = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def ensure(self, generation: int, policies: list) -> bool:
+        """Make sure workers hold ``policies`` (by generation). Returns
+        True when the pool is ready for that generation; a miss kicks a
+        BACKGROUND rebuild and returns False — spawning workers costs
+        seconds and must never block an admission request."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if self._pool is not None and self._generation == generation:
+                return True
+            if self._building is not None:
+                return False
+            self._building = generation
+            raws = [p.raw for p in policies]
+
+        def build():
+            try:
+                # workers spawn through the env-scrubbing launcher, so no
+                # child can claim the parent's accelerator and the
+                # parent's environment is never touched
+                if self._launcher is None:
+                    self._launcher = _make_worker_launcher()
+                self._ctx.set_executable(self._launcher)
+                pool = ProcessPoolExecutor(
+                    max_workers=self.workers, mp_context=self._ctx,
+                    initializer=_worker_init, initargs=(raws,))
+                import concurrent.futures as cf
+
+                warm = [pool.submit(_worker_ready)
+                        for _ in range(self.workers)]
+                cf.wait(warm, timeout=120)
+            except Exception:
+                with self._lock:
+                    self._building = None
+                return
+            with self._lock:
+                old, self._pool = self._pool, pool
+                self._generation = generation
+                self._building = None
+            if old is not None:
+                old.shutdown(wait=False, cancel_futures=True)
+
+        threading.Thread(target=build, name="oracle-pool-build",
+                         daemon=True).start()
+        return False
+
+    def ready(self, generation: int) -> bool:
+        with self._lock:
+            return self._pool is not None and self._generation == generation
+
+    def evaluate(self, names: list[str], resource: dict, request: dict,
+                 ns_labels: dict, roles: list, cluster_roles: list,
+                 exclude_group_role: list, timeout_s: float = 3.0):
+        """Submit one admission's enforce loop; returns the serialized
+        results or None (caller falls back inline). Consecutive misses
+        open a cooldown breaker; a broken executor (worker OOM-kill)
+        drops the pool so ensure() rebuilds it."""
+        import time
+
+        with self._lock:
+            pool = self._pool
+            if (pool is None
+                    or time.monotonic() < self._disabled_until
+                    or self._inflight >= 2 * self.workers):
+                return None
+            self._inflight += 1
+        broken = False
+        try:
+            fut = pool.submit(_worker_evaluate, names, resource, request,
+                              ns_labels, roles, cluster_roles,
+                              exclude_group_role)
+            out = fut.result(timeout=timeout_s)
+            with self._lock:
+                self.hits += 1
+                self._consecutive_misses = 0
+            return out
+        except Exception as e:
+            fut = locals().get("fut")
+            if fut is not None:
+                fut.cancel()        # a queued (not yet running) task dies
+            from concurrent.futures.process import BrokenProcessPool
+
+            broken = isinstance(e, BrokenProcessPool)
+            with self._lock:
+                self.misses += 1
+                self._consecutive_misses += 1
+                if self._consecutive_misses >= self.miss_threshold:
+                    self._disabled_until = (time.monotonic()
+                                            + self.miss_cooldown_s)
+                    self._consecutive_misses = 0
+                if broken and self._pool is pool:
+                    # executor is dead; next ensure() rebuilds
+                    self._pool = None
+                    self._generation = -1
+            if broken:
+                pool.shutdown(wait=False, cancel_futures=True)
+            return None
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def stop(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _worker_ready() -> dict:
+    """Warm-up no-op: forces worker spawn + module import + policy load.
+    Returns the worker's accelerator-relevant env for test assertions."""
+    import sys
+
+    return {
+        "policies": len(_worker_policies),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "accel_env": {k: os.environ.get(k) for k in _ACCEL_ENV},
+        "jax_loaded": "jax" in sys.modules,
+    }

@@ -154,6 +154,18 @@ class PolicyCache:
         with self._lock:
             return list(self._policies.values())
 
+    def snapshot(self) -> tuple[int, list[ClusterPolicy]]:
+        """(generation, policies) read atomically — consumers that key
+        caches by generation (the oracle pool) must never pair one
+        generation's number with another generation's policy content."""
+        with self._lock:
+            return self._generation, list(self._policies.values())
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
     # ------------------------------------------------------------ tensors
 
     def compiled(self, ptype: PolicyType, kind: str, namespace: str = ""):

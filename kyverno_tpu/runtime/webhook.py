@@ -92,6 +92,10 @@ class WebhookServer:
         self.event_gen = event_gen
         self.report_gen = report_gen
         self.image_verifier = image_verifier or Verifier()
+        from .oracle_pool import OraclePool
+
+        # multicore oracle lane; dormant below OraclePool.MIN_CORES
+        self.oracle_pool = OraclePool()
         self.resource_cache = (ResourceCache(client)
                                if client is not None else None)
         self.registry = registry or metrics_mod.registry()
@@ -369,10 +373,20 @@ class WebhookServer:
                     resource, kind, request)
                 run_policies = [p for p in enforce if p.name in bad]
             oracle_t0 = time.monotonic()
-            pctx = self._policy_context(request, resource)
-            for policy in run_policies:
-                pctx.policy = policy
-                resp = engine_validate(pctx)
+            # multicore lane: cluster-independent policies can evaluate in
+            # a worker process (runtime/oracle_pool.py) — the GIL
+            # serializes the inline loop, so on a multicore host a burst
+            # of admissions scales with cores the way the reference's
+            # goroutines do. Any miss falls through to the inline loop.
+            responses = self._pool_oracle(run_policies, resource, request,
+                                          namespace)
+            if responses is None:
+                responses = []
+                pctx = self._policy_context(request, resource)
+                for policy in run_policies:
+                    pctx.policy = policy
+                    responses.append(engine_validate(pctx))
+            for policy, resp in zip(run_policies, responses):
                 for rule in resp.policy_response.rules:
                     metrics_mod.record_policy_results(
                         self.registry, policy.name, rule.name,
@@ -411,6 +425,79 @@ class WebhookServer:
         # generate policies -> GenerateRequest documents (server.go:562)
         self._apply_generate_policies(request)
         return _admission_response(uid, True)
+
+    def _pool_oracle(self, policies, resource: dict, request: dict,
+                     namespace: str):
+        """Try the multiprocess oracle lane for this admission's enforce
+        loop. Returns EngineResponses aligned with ``policies`` or None
+        (caller runs inline). Only engages when the pool is warm for the
+        current policy generation and every policy is cluster-independent
+        (runtime/oracle_pool.py pool_safe)."""
+        pool = self.oracle_pool
+        if pool is None or not pool.enabled or len(policies) < 2:
+            return None
+        from .oracle_pool import pool_safe
+
+        if not all(pool_safe(p) for p in policies):
+            return None
+        # warm-pool fast path: don't snapshot the whole policy list per
+        # admission just for ensure() to discard it after an int compare
+        generation = self.policy_cache.generation
+        if not pool.ready(generation):
+            # kicks a background build from an ATOMIC (generation,
+            # policies) pair — the pool must never hold one generation's
+            # number with another generation's content
+            pool.ensure(*self.policy_cache.snapshot())
+            return None
+        user_info = request.get("userInfo") or {}
+        info = build_request_info(self.client, user_info)
+        namespace_labels = {}
+        if namespace and self.resource_cache is not None:
+            namespace_labels = self.resource_cache.get_namespace_labels(
+                namespace)
+        results = pool.evaluate(
+            [p.name for p in policies], resource, request, namespace_labels,
+            info.roles, info.cluster_roles,
+            self.config.get_exclude_group_role())
+        if results is None:
+            return None
+        by_name = dict(results)
+        from ..engine.response import (
+            EngineResponse,
+            PolicyResponse,
+            PolicySpecSummary,
+            ResourceSpec,
+            RuleResponse,
+            RuleType,
+        )
+
+        # DELETE admissions carry the identity on oldObject (object is
+        # null) — mirror the inline engine's fallback so events/reports
+        # name the resource either way
+        ident = resource or request.get("oldObject") or {}
+        meta = ident.get("metadata") or {}
+        out = []
+        for policy in policies:
+            rows = by_name.get(policy.name)
+            if rows is None:
+                return None      # worker set out of date: run inline
+            resp = EngineResponse(policy_response=PolicyResponse(
+                policy=PolicySpecSummary(
+                    name=policy.name,
+                    validation_failure_action=(
+                        policy.spec.validation_failure_action)),
+                resource=ResourceSpec(
+                    kind=ident.get("kind", ""),
+                    api_version=ident.get("apiVersion", ""),
+                    namespace=meta.get("namespace", ""),
+                    name=meta.get("name", ""),
+                    uid=meta.get("uid", ""))))
+            for rule_name, status_value, message in rows:
+                resp.policy_response.rules.append(RuleResponse(
+                    name=rule_name, type=RuleType.VALIDATION,
+                    message=message, status=RuleStatus(status_value)))
+            out.append(resp)
+        return out
 
     def _process_audit(self, request: dict) -> None:
         """validate_audit.go:151 process."""
@@ -595,6 +682,8 @@ class WebhookServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        if self.oracle_pool is not None:
+            self.oracle_pool.stop()
         self.audit_handler.stop()
         if self.event_gen is not None:
             self.event_gen.stop()

@@ -1,0 +1,207 @@
+"""Multiprocess oracle lane (runtime/oracle_pool.py).
+
+The sandbox has one core so the pool is dormant by default; these tests
+force it on (min_cores=1) to prove the spawn workers produce verdicts
+identical to the inline engine, that cluster-dependent policies are
+refused, and that the webhook integration blocks/admits through the pool
+exactly as the inline loop does."""
+
+import time
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.oracle_pool import OraclePool, pool_safe
+
+ENFORCE = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest"},
+    "spec": {"validationFailureAction": "enforce", "rules": [{
+        "name": "no-latest",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "latest tag not allowed",
+                     "pattern": {"spec": {"containers": [
+                         {"image": "!*:latest"}]}}},
+    }]},
+}
+
+REQUIRE_LABEL = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "require-team"},
+    "spec": {"validationFailureAction": "enforce", "rules": [{
+        "name": "team",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "team label required",
+                     "pattern": {"metadata": {"labels": {"team": "?*"}}},
+                     },
+    }]},
+}
+
+CONTEXT_POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "uses-context"},
+    "spec": {"rules": [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "context": [{"name": "cm", "configMap": {"name": "x",
+                                                 "namespace": "default"}}],
+        "validate": {"pattern": {"metadata": {"name": "?*"}}},
+    }]},
+}
+
+
+def pod(image, name="p", labels=None):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         **({"labels": labels} if labels else {})},
+            "spec": {"containers": [{"name": "c", "image": image}]}}
+
+
+def review(resource):
+    return {"uid": "u1", "kind": {"kind": "Pod"}, "namespace": "default",
+            "operation": "CREATE", "object": resource,
+            "userInfo": {"username": "alice", "groups": ["dev"]}}
+
+
+def _wait_ready(pool, generation, timeout_s=60.0):
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if pool.ready(generation):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_pool_safe_classification():
+    assert pool_safe(load_policy(ENFORCE))
+    assert not pool_safe(load_policy(CONTEXT_POLICY))
+
+
+class TestOraclePool:
+    def test_worker_verdicts_match_inline_engine(self):
+        policies = [load_policy(ENFORCE), load_policy(REQUIRE_LABEL)]
+        pool = OraclePool(workers=2, min_cores=1)
+        assert pool.enabled
+        try:
+            pool.ensure(1, policies)
+            assert _wait_ready(pool, 1)
+
+            bad = pod("nginx:latest")
+            out = pool.evaluate(
+                ["disallow-latest", "require-team"], bad, review(bad),
+                {}, [], [], [])
+            assert out is not None
+            results = dict(out)
+            assert results["disallow-latest"][0][1] == "fail"
+            assert "latest tag" in results["disallow-latest"][0][2]
+            assert results["require-team"][0][1] == "fail"
+
+            good = pod("nginx:1.21", labels={"team": "x"})
+            out = dict(pool.evaluate(
+                ["disallow-latest", "require-team"], good, review(good),
+                {}, [], [], []))
+            assert out["disallow-latest"][0][1] == "pass"
+            assert out["require-team"][0][1] == "pass"
+        finally:
+            pool.stop()
+
+    def test_generation_change_rebuilds(self):
+        pool = OraclePool(workers=1, min_cores=1)
+        try:
+            pool.ensure(1, [load_policy(ENFORCE)])
+            assert _wait_ready(pool, 1)
+            # new generation: not ready until the background rebuild lands
+            assert pool.ensure(2, [load_policy(REQUIRE_LABEL)]) is False
+            assert _wait_ready(pool, 2)
+            bad = pod("nginx:latest")
+            out = dict(pool.evaluate(["require-team"], bad, review(bad),
+                                     {}, [], [], []))
+            assert out["require-team"][0][1] == "fail"
+        finally:
+            pool.stop()
+
+    def test_disabled_below_core_floor(self):
+        pool = OraclePool(min_cores=4096)
+        assert not pool.enabled
+        assert pool.ensure(1, []) is False
+
+
+class TestWebhookIntegration:
+    def test_admission_through_pool_blocks_and_admits(self):
+        from kyverno_tpu.runtime.policycache import PolicyCache
+        from kyverno_tpu.runtime.webhook import WebhookServer
+
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        cache.add(load_policy(REQUIRE_LABEL))
+        server = WebhookServer(policy_cache=cache, client=FakeCluster())
+        server.oracle_pool.stop()
+        server.oracle_pool = OraclePool(workers=2, min_cores=1)
+        try:
+            generation = cache.generation
+            server.oracle_pool.ensure(generation, cache.all_policies())
+            assert _wait_ready(server.oracle_pool, generation)
+
+            resp = server._resource_validation(review(pod("nginx:latest")))
+            assert resp["response"]["allowed"] is False
+            assert "latest tag" in resp["response"]["status"]["message"]
+            assert "require-team" in resp["response"]["status"]["message"]
+
+            ok = server._resource_validation(
+                review(pod("nginx:1.21", labels={"team": "x"})))
+            assert ok["response"]["allowed"] is True
+            # both admissions actually went through the worker processes
+            assert server.oracle_pool.hits == 2
+        finally:
+            server.stop()
+
+    def test_context_policy_forces_inline(self):
+        """A policy with context entries must not take the pool lane."""
+        from kyverno_tpu.runtime.policycache import PolicyCache
+        from kyverno_tpu.runtime.webhook import WebhookServer
+
+        cluster = FakeCluster([{
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"namespace": "default", "name": "x"},
+            "data": {"k": "v"}}])
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        cache.add(load_policy(CONTEXT_POLICY))
+        server = WebhookServer(policy_cache=cache, client=cluster)
+        server.oracle_pool.stop()
+        server.oracle_pool = OraclePool(workers=1, min_cores=1)
+        try:
+            out = server._pool_oracle(
+                cache.all_policies(), pod("nginx:1.21"),
+                review(pod("nginx:1.21")), "default")
+            assert out is None     # refused: context policy in the set
+            # and the full path still answers correctly inline
+            resp = server._resource_validation(review(pod("nginx:latest")))
+            assert resp["response"]["allowed"] is False
+        finally:
+            server.stop()
+
+
+class TestAcceleratorIsolation:
+    def test_workers_never_touch_the_accelerator(self, monkeypatch):
+        """Spawned workers must come up with the accelerator env scrubbed
+        (the sandbox's sitecustomize claims a TPU PJRT backend when it
+        sees it) and without jax loaded at all."""
+        from kyverno_tpu.runtime.oracle_pool import _worker_ready
+
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "203.0.113.7")
+        pool = OraclePool(workers=1, min_cores=1)
+        try:
+            pool.ensure(1, [load_policy(ENFORCE)])
+            assert _wait_ready(pool, 1)
+            # the parent env is restored after the spawn window
+            import os
+            assert os.environ["PALLAS_AXON_POOL_IPS"] == "203.0.113.7"
+            info = pool._pool.submit(_worker_ready).result(timeout=30)
+            assert info["policies"] == 1
+            assert info["jax_platforms"] == "cpu"
+            assert info["accel_env"] == {"PALLAS_AXON_POOL_IPS": None}
+            assert info["jax_loaded"] is False
+        finally:
+            pool.stop()
