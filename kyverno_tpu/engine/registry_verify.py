@@ -136,8 +136,19 @@ class RegistryClient:
         """(manifest dict, digest) for a tag or digest reference."""
         body, headers = self._get(
             registry, f"/v2/{repo}/manifests/{ref}", MANIFEST_ACCEPT)
-        digest = headers.get("Docker-Content-Digest") or (
-            "sha256:" + hashlib.sha256(body).hexdigest())
+        # the digest is ALWAYS computed from the returned bytes (what
+        # cosign does): trusting Docker-Content-Digest would let a
+        # compromised registry claim a signed image's digest while
+        # serving different manifest content. The header, when present,
+        # is only cross-checked — a mismatch is a registry lying.
+        digest = "sha256:" + hashlib.sha256(body).hexdigest()
+        claimed = (headers.get("Docker-Content-Digest") or "").strip().lower()
+        # only a sha256 claim is comparable; other algorithms (sha512:...)
+        # are spec-legal and simply not cross-checked
+        if claimed.startswith("sha256:") and claimed != digest:
+            raise VerificationError(
+                f"registry digest header {claimed} does not match "
+                f"manifest content {digest} for {repo}")
         try:
             return json.loads(body), digest
         except ValueError as e:

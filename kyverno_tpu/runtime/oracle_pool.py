@@ -129,10 +129,16 @@ def _worker_evaluate(names: list[str], resource: dict, request: dict,
 
 def pool_safe(policy) -> bool:
     """True when every rule of the policy evaluates without a cluster
-    client: no context entries (ConfigMap/APICall loads)."""
+    client: no context entries (ConfigMap/APICall loads) at the rule
+    level OR inside foreach entries — validate foreach carries its own
+    ``context:`` list loaded per-iteration (ForEach.context), and a
+    worker has no client/resource_cache to serve it."""
     for rule in policy.spec.rules:
         if rule.context:
             return False
+        for fe in list(rule.validation.foreach) + list(rule.mutation.foreach):
+            if fe.context:
+                return False
     return True
 
 

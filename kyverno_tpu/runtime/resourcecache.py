@@ -35,9 +35,11 @@ class ResourceCache:
         self.resync_s = resync_s
         self.informer_sync_timeout_s = informer_sync_timeout_s
         self._lock = threading.Lock()
+        self._informer_create_lock = threading.Lock()
         self._entries: dict[tuple, _Entry] = {}
         self._watching = False
         self._informed: dict[tuple, object] = {}  # (apiVersion, kind) -> Reflector
+        self._event_kinds: set[str] = set()       # kinds with events flowing
         self._sync_waited: set[tuple] = set()
         self.lookups = 0
         self.fetches = 0
@@ -58,8 +60,7 @@ class ResourceCache:
             # informer-watched kinds hold complete state: upsert every
             # event; the global FakeCluster watch only maintains keys a
             # reader already populated
-            if key not in self._entries and not any(
-                    k == kind for _, k in self._informed):
+            if key not in self._entries and kind not in self._event_kinds:
                 return
             if event == "DELETED":
                 self._entries[key] = _Entry(None, time.monotonic())
@@ -88,12 +89,31 @@ class ResourceCache:
         gvk = (api_version, kind)
         with self._lock:
             refl = self._informed.get(gvk)
-            if refl is None:
-                refl = self.client.ensure_informer(
-                    api_version, kind,
-                    on_event=self._on_event,
-                    on_sync=lambda items, k=kind: self._on_informer_sync(
-                        k, items))
+        if refl is not None:
+            return refl
+        # ensure_informer may synchronously replay on_sync when the shared
+        # WatchHub already holds a synced reflector for this GVK, and
+        # _on_informer_sync takes self._lock — so the call must happen
+        # OUTSIDE self._lock (non-reentrant: holding it here deadlocks the
+        # admission thread). A separate creation mutex keeps the register
+        # single-shot per GVK without involving self._lock.
+        with self._informer_create_lock:
+            with self._lock:
+                refl = self._informed.get(gvk)
+                if refl is None:
+                    # open the event gate BEFORE registering: the hub
+                    # starts delivering events the moment callbacks are in,
+                    # and _on_event must not drop them (a dropped ADDED
+                    # reads back as a confirmed absence until a re-list)
+                    self._event_kinds.add(kind)
+            if refl is not None:
+                return refl
+            refl = self.client.ensure_informer(
+                api_version, kind,
+                on_event=self._on_event,
+                on_sync=lambda items, k=kind: self._on_informer_sync(
+                    k, items))
+            with self._lock:
                 self._informed[gvk] = refl
         return refl
 

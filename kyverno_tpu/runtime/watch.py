@@ -121,7 +121,14 @@ class Reflector:
                 resource_version=self.last_resource_version,
                 stop=self._stop):
             if ev_type == "ERROR":
-                return (obj or {}).get("code") == 410
+                if (obj or {}).get("code") == 410:
+                    return True
+                # a non-410 Status (e.g. a 500) is a server-side failure,
+                # not a clean close: surface it as a watch failure so the
+                # outer loop backs off and eventually escalates to a
+                # re-list, instead of hot-looping zero-delay reconnects
+                raise _WatchError(
+                    f"watch ERROR frame: {json.dumps(obj)[:200]}")
             rv = ((obj or {}).get("metadata") or {}).get("resourceVersion")
             if rv:
                 self.last_resource_version = rv
@@ -137,6 +144,10 @@ class _Relist(Exception):
     """410 Gone: restart from a fresh list."""
 
 
+class _WatchError(Exception):
+    """Server-sent non-410 ERROR frame: retry the watch with backoff."""
+
+
 class WatchHub:
     """Per-GVK reflector registry — the ResourceCache's informer factory
     (resourcecache.go CreateGVKInformer). ensure() is idempotent; all
@@ -147,22 +158,32 @@ class WatchHub:
         self._lock = threading.Lock()
         self._reflectors: dict[tuple, Reflector] = {}
         self._callbacks: dict[tuple, list] = {}
-        self._last_sync: dict[tuple, list] = {}
+        # watch-maintained object map per key ((ns, name) -> obj), kept
+        # current by _fan_event — a late subscriber's replay must reflect
+        # every event since the last list, not the stale list itself
+        self._state: dict[tuple, dict] = {}
+        # serializes state mutation + callback delivery with the replay in
+        # ensure(): without it a replay captured at state vN could be
+        # delivered AFTER event N+1 reached the same subscriber, and a
+        # wholesale-replacing on_sync would clobber the newer event.
+        # RLock so an (ill-advised) ensure() from inside a callback
+        # degrades to a stale-replay, not a deadlock.
+        self._deliver_lock = threading.RLock()
+
+    @staticmethod
+    def _obj_key(obj: dict) -> tuple:
+        meta = obj.get("metadata") or {}
+        return (meta.get("namespace", ""), meta.get("name", ""))
 
     def ensure(self, api_version: str, kind: str, namespace: str = "",
                on_event=None, on_sync=None) -> Reflector:
         key = (api_version, kind, namespace or "")
-        replay = None
         with self._lock:
             cbs = self._callbacks.setdefault(key, [])
             if on_event or on_sync:
                 cbs.append((on_event, on_sync))
-                # a subscriber joining an already-synced reflector missed
-                # the initial list — replay the last snapshot so "missing
-                # key = confirmed absence" consumers start complete
-                if on_sync is not None and key in self._last_sync:
-                    replay = self._last_sync[key]
             refl = self._reflectors.get(key)
+            started = refl is not None
             if refl is None:
                 refl = Reflector(
                     self.client, api_version, kind, namespace,
@@ -170,31 +191,47 @@ class WatchHub:
                     on_sync=lambda items, k=key: self._fan_sync(k, items),
                 )
                 self._reflectors[key] = refl
-                refl.start()
-        if replay is not None:
-            try:
-                on_sync(replay)
-            except Exception:
-                pass
+        if started and on_sync is not None:
+            # joining an already-running reflector: replay the CURRENT
+            # watch-maintained state (list + every event since) so
+            # "missing key = confirmed absence" consumers start complete;
+            # the delivery lock orders the replay before any later event
+            with self._deliver_lock:
+                state = self._state.get(key)
+                if state is not None:
+                    try:
+                        on_sync(list(state.values()))
+                    except Exception:
+                        pass
+        if not started:
+            refl.start()
         return refl
 
     def _fan_event(self, key, ev_type, obj) -> None:
-        for on_event, _ in list(self._callbacks.get(key, [])):
-            if on_event is not None:
-                try:
-                    on_event(ev_type, obj)
-                except Exception:
-                    pass
+        with self._deliver_lock:
+            state = self._state.get(key)
+            if state is not None and ev_type in (
+                    "ADDED", "MODIFIED", "DELETED"):
+                if ev_type == "DELETED":
+                    state.pop(self._obj_key(obj), None)
+                else:
+                    state[self._obj_key(obj)] = obj
+            for on_event, _ in list(self._callbacks.get(key, [])):
+                if on_event is not None:
+                    try:
+                        on_event(ev_type, obj)
+                    except Exception:
+                        pass
 
     def _fan_sync(self, key, items) -> None:
-        with self._lock:
-            self._last_sync[key] = items
-        for _, on_sync in list(self._callbacks.get(key, [])):
-            if on_sync is not None:
-                try:
-                    on_sync(items)
-                except Exception:
-                    pass
+        with self._deliver_lock:
+            self._state[key] = {self._obj_key(o): o for o in items}
+            for _, on_sync in list(self._callbacks.get(key, [])):
+                if on_sync is not None:
+                    try:
+                        on_sync(items)
+                    except Exception:
+                        pass
 
     def stop(self) -> None:
         with self._lock:
@@ -202,6 +239,7 @@ class WatchHub:
                 refl.stop()
             self._reflectors.clear()
             self._callbacks.clear()
+            self._state.clear()
 
 
 def decode_watch_line(line: bytes):

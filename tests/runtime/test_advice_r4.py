@@ -1,0 +1,232 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4).
+
+Each test pins the exact failure mode the advisor described:
+
+1. ``pool_safe`` must reject policies whose *foreach* entries carry
+   context loads — workers have no cluster client, so such policies
+   error in the pool and an enforce policy would deny admissions that
+   pass inline.
+2. ``ResourceCache._ensure_informer`` must not hold the cache lock while
+   calling ``client.ensure_informer``: a WatchHub with an already-synced
+   reflector replays ``on_sync`` synchronously, which re-acquires the
+   same non-reentrant lock — a permanent deadlock of the admission
+   thread.
+3. ``RegistryClient.manifest`` must compute the digest from the manifest
+   bytes, never trust the registry's Docker-Content-Digest header (a
+   compromised registry could claim a signed digest for unsigned bytes).
+4. A non-410 ERROR watch frame (e.g. a 500 Status) is a server-side
+   failure, not a clean close: the reflector must back off and escalate
+   to a re-list instead of hot-looping zero-delay reconnects.
+"""
+
+import threading
+import time
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.oracle_pool import pool_safe
+from kyverno_tpu.runtime.resourcecache import ResourceCache
+from kyverno_tpu.runtime.watch import Reflector
+
+
+def _policy(rule_extra: dict) -> dict:
+    rule = {
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "m",
+                     "pattern": {"spec": {"hostPID": "false"}}},
+    }
+    rule.update(rule_extra)
+    return {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"rules": [rule]},
+    }
+
+
+class TestPoolSafeForeachContext:
+    def test_plain_policy_is_safe(self):
+        assert pool_safe(load_policy(_policy({})))
+
+    def test_rule_context_rejected(self):
+        p = load_policy(_policy({"context": [{
+            "name": "cm", "configMap": {"name": "c", "namespace": "d"}}]}))
+        assert not pool_safe(p)
+
+    def test_validate_foreach_context_rejected(self):
+        p = load_policy(_policy({"validate": {"foreach": [{
+            "list": "request.object.spec.containers",
+            "context": [{"name": "cm",
+                         "configMap": {"name": "c", "namespace": "d"}}],
+            "pattern": {"image": "*:latest"},
+        }]}}))
+        assert not pool_safe(p)
+
+    def test_mutate_foreach_context_rejected(self):
+        p = load_policy(_policy({"validate": None, "mutate": {"foreach": [{
+            "list": "request.object.spec.containers",
+            "context": [{"name": "cm",
+                         "configMap": {"name": "c", "namespace": "d"}}],
+            "patchStrategicMerge": {"x": "y"},
+        }]}}))
+        assert not pool_safe(p)
+
+    def test_contextless_foreach_stays_safe(self):
+        p = load_policy(_policy({"validate": {"foreach": [{
+            "list": "request.object.spec.containers",
+            "pattern": {"image": "!*:latest"},
+        }]}}))
+        assert pool_safe(p)
+
+
+class _SyncReplayClient:
+    """ensure_informer replays on_sync synchronously — the WatchHub
+    behavior when a synced reflector for the GVK already exists (another
+    consumer, e.g. CrdSync, registered it first)."""
+
+    def __init__(self, items):
+        self.items = items
+
+    def ensure_informer(self, api_version, kind, on_event=None, on_sync=None):
+        if on_sync is not None:
+            on_sync(self.items)          # synchronous replay
+
+        class _Refl:
+            @staticmethod
+            def wait_synced(timeout_s=10.0):
+                return True
+
+        return _Refl()
+
+    def get_resource(self, *a):          # pragma: no cover - not reached
+        raise AssertionError("informer-synced lookup must not GET")
+
+
+class TestEnsureInformerNoDeadlock:
+    def test_synchronous_sync_replay_does_not_deadlock(self):
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "prod", "labels": {"env": "prod"}}}
+        cache = ResourceCache(_SyncReplayClient([ns]))
+        out = {}
+
+        def lookup():
+            out["labels"] = cache.get_namespace_labels("prod")
+
+        t = threading.Thread(target=lookup, daemon=True)
+        t.start()
+        t.join(5.0)
+        assert not t.is_alive(), "ensure_informer replay deadlocked the cache"
+        assert out["labels"] == {"env": "prod"}
+
+
+class _ErrorFrameClient:
+    """list succeeds; every watch stream yields one non-410 ERROR frame."""
+
+    def __init__(self):
+        self.lists = 0
+        self.watches = 0
+
+    def list_response(self, api_version, kind, namespace):
+        self.lists += 1
+        return {"metadata": {"resourceVersion": str(self.lists)}, "items": []}
+
+    def watch_stream(self, api_version, kind, namespace,
+                     resource_version=None, stop=None):
+        self.watches += 1
+        yield "ERROR", {"kind": "Status", "code": 500,
+                        "message": "etcdserver: leader changed"}
+
+
+class TestNon410ErrorFrame:
+    def test_error_frame_backs_off_and_relists(self):
+        client = _ErrorFrameClient()
+        refl = Reflector(client, "v1", "Pod",
+                         backoff_base_s=0.005, backoff_cap_s=0.05,
+                         max_watch_failures=2)
+        refl.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and client.lists < 2:
+                time.sleep(0.01)
+            # persistent 500s escalated to a re-list (not a hot loop that
+            # never leaves the watch phase)
+            assert client.lists >= 2
+            # and the reconnects were bounded by backoff: in the elapsed
+            # window a zero-delay hot loop would make thousands of watch
+            # calls; the backed-off loop stays in the low tens
+            assert client.watches < 200
+        finally:
+            refl.stop()
+
+
+class TestLateJoinerReplayIsCurrent:
+    def test_replay_includes_events_since_last_list(self):
+        """A subscriber joining an already-synced shared reflector must be
+        replayed the watch-maintained state (list + events since), not the
+        stale last list — otherwise objects created after the list read
+        back as confirmed absences in the late joiner."""
+        from kyverno_tpu.runtime.watch import WatchHub
+
+        class _Client:
+            def __init__(self):
+                self.stream_open = threading.Event()
+                self.release = threading.Event()
+
+            def list_response(self, api_version, kind, namespace):
+                return {"metadata": {"resourceVersion": "1"},
+                        "items": [{"metadata": {"name": "a"}}]}
+
+            def watch_stream(self, api_version, kind, namespace,
+                             resource_version=None, stop=None):
+                yield "ADDED", {"metadata": {"name": "b",
+                                             "resourceVersion": "2"}}
+                self.stream_open.set()
+                self.release.wait(5.0)
+
+        client = _Client()
+        hub = WatchHub(client)
+        try:
+            hub.ensure("v1", "Pod", on_sync=lambda items: None)
+            assert client.stream_open.wait(5.0)
+            seen = {}
+            hub.ensure("v1", "Pod",
+                       on_sync=lambda items: seen.setdefault(
+                           "names", sorted((o.get("metadata") or {})["name"]
+                                           for o in items)))
+            # the replay carries BOTH the listed object and the one that
+            # arrived via watch after the list
+            assert seen.get("names") == ["a", "b"]
+        finally:
+            client.release.set()
+            hub.stop()
+
+
+class TestManifestDigestFromBytes:
+    def test_lying_digest_header_rejected(self):
+        import hashlib
+        import json as _json
+
+        from kyverno_tpu.engine.registry_verify import (
+            RegistryClient, VerificationError)
+
+        body = _json.dumps({"schemaVersion": 2, "layers": []}).encode()
+        good = "sha256:" + hashlib.sha256(body).hexdigest()
+        evil = "sha256:" + "0" * 64
+
+        class _Client(RegistryClient):
+            def __init__(self, header):
+                super().__init__()
+                self.header = header
+
+            def _get(self, registry, path, accept=None, _retried=False):
+                return body, {"Docker-Content-Digest": self.header}
+
+        # honest header: digest comes back equal to the content hash
+        _, digest = _Client(good).manifest("r.io", "a/b", "latest")
+        assert digest == good
+        # lying header: hard failure, never the claimed digest
+        try:
+            _Client(evil).manifest("r.io", "a/b", "latest")
+        except VerificationError as e:
+            assert "does not match" in str(e)
+        else:
+            raise AssertionError("lying Docker-Content-Digest accepted")
