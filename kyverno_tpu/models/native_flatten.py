@@ -31,30 +31,62 @@ _CPP = _REPO_ROOT / "native" / "ktpu_flatten.cpp"
 _SO = _REPO_ROOT / "native" / "build" / "libktpu_flatten.so"
 
 _lib = None
+_pylib = None          # PyDLL view of the same .so (GIL-holding entries)
 _lib_failed = False
 _lib_lock = __import__("threading").Lock()
 
 
+def _build_cmds(tmp):
+    """Candidate compiles, tried in order: with Python headers (enables
+    the PyObject direct-walk entry), then without (KTPU_NO_PYTHON)."""
+    import sysconfig
+
+    base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            str(_CPP), "-o", str(tmp)]
+    inc = sysconfig.get_paths().get("include")
+    cmds = []
+    if inc and os.path.isdir(inc):
+        cmds.append(base[:6] + [f"-I{inc}"] + base[6:])
+    cmds.append(base[:6] + ["-DKTPU_NO_PYTHON"] + base[6:])
+    return cmds
+
+
 def _load_lib():
-    global _lib, _lib_failed
+    global _lib, _pylib, _lib_failed
     if _lib is not None or _lib_failed:
         return _lib
     with _lib_lock:
         if _lib is not None or _lib_failed:
             return _lib
         try:
-            if not _SO.exists() or _SO.stat().st_mtime < _CPP.stat().st_mtime:
+            lib = None
+            if _SO.exists() and _SO.stat().st_mtime >= _CPP.stat().st_mtime:
+                try:
+                    lib = ctypes.CDLL(str(_SO))
+                except OSError:
+                    lib = None          # broken artifact: rebuild below
+            if lib is None:
                 _SO.parent.mkdir(parents=True, exist_ok=True)
                 # build to a temp name, then atomic rename: a concurrent
-                # process must never CDLL a half-written .so
+                # process must never CDLL a half-written .so. Each build
+                # candidate must also *load* — a with-Python .so whose
+                # Py* symbols can't resolve at dlopen (embedded or
+                # statically linked interpreters) falls through to the
+                # KTPU_NO_PYTHON build instead of poisoning the cache.
                 tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     str(_CPP), "-o", str(tmp)],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _SO)
-            lib = ctypes.CDLL(str(_SO))
+                err: Exception | None = None
+                for cmd in _build_cmds(tmp):
+                    try:
+                        subprocess.run(cmd, check=True, capture_output=True,
+                                       timeout=120)
+                        os.replace(tmp, _SO)
+                        lib = ctypes.CDLL(str(_SO))
+                        err = None
+                        break
+                    except (subprocess.SubprocessError, OSError) as e:
+                        err = e
+                if lib is None:
+                    raise err if err is not None else OSError("build failed")
         except (OSError, subprocess.SubprocessError):
             _lib_failed = True
             return None
@@ -86,6 +118,24 @@ def _load_lib():
             ctypes.c_void_p, ctypes.c_void_p,      # dictv, str_bytes
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int,  # n_strings, str_cap
         ]
+        # the PyObject walk entry needs the GIL held across the call:
+        # load the same .so a second time as a PyDLL (no GIL release).
+        # Absent when the build fell back to -DKTPU_NO_PYTHON.
+        try:
+            pl = ctypes.PyDLL(str(_SO))
+            pl.ktpu_flatten_packed_py.restype = ctypes.c_int
+            pl.ktpu_flatten_packed_py.argtypes = [
+                ctypes.c_void_p,
+                ctypes.py_object, ctypes.py_object,  # docs, reqs (py lists)
+                ctypes.c_int, ctypes.c_int,          # n_docs, max_slots
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_void_p, ctypes.c_void_p,    # cells, bmeta
+                ctypes.c_void_p, ctypes.c_void_p,    # dictv, str_bytes
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ]
+        except (OSError, AttributeError):
+            pl = None
+        _pylib = pl
         _lib = lib
         return lib
 
@@ -248,32 +298,14 @@ class NativeFlattener:
         )
 
 
-    def flatten_packed(self, resources: list[dict] | None = None,
-                       max_slots: int = 16,
-                       requests: list[dict] | None = None,
-                       json_docs: bytes | None = None,
-                       n_docs: int | None = None,
-                       json_reqs: bytes | None = None):
-        """Flatten straight into the packed transfer form (PackedBatch),
-        or None on any failure. ``json_docs`` (a JSON array of documents,
-        e.g. the items of an apiserver list response) skips the Python
-        json.dumps — the scan regime's input is wire bytes, and the dumps
-        held the GIL for as long as the whole native parse took."""
+    def _packed_retry_loop(self, B: int, max_slots: int, invoke):
+        """The -1/-4 retry protocol shared by every packed entry:
+        ``invoke(e_cap, e_needed, cells, bmeta, dictv, str_bytes,
+        n_strings, str_cap)`` makes one native call and returns e_used.
+        Returns a PackedBatch or None on unrecoverable failure."""
         from .flatten import PackedBatch
 
-        if json_docs is not None:
-            docs, B = json_docs, int(n_docs)
-            reqs = json_reqs
-        else:
-            B = len(resources)
-            try:
-                docs = json.dumps(resources).encode("utf-8")
-                reqs = (json.dumps(requests).encode("utf-8")
-                        if requests is not None else None)
-            except (TypeError, ValueError):
-                return None
         P = self.tensors.n_paths
-
         e_cap = min(max(4, self._e_guess), max_slots)
         str_cap = self._str_cap_guess(B)
         while True:
@@ -284,19 +316,17 @@ class NativeFlattener:
             str_bytes = np.zeros((str_cap, STR_LEN), dtype=np.uint8)
             n_strings = ctypes.c_int32(0)
             e_needed = ctypes.c_int32(0)
-            e_used = self._lib.ktpu_flatten_packed(
-                self._handle, docs, len(docs), reqs,
-                len(reqs) if reqs is not None else 0,
-                B, max_slots, e_cap, ctypes.byref(e_needed),
-                _ptr(cells), _ptr(bmeta), _ptr(dictv), _ptr(str_bytes),
-                ctypes.byref(n_strings), str_cap,
-            )
+            e_used = invoke(e_cap, e_needed, cells, bmeta, dictv, str_bytes,
+                            n_strings, str_cap)
             if e_used == -1:
+                # n_strings reports the exact dictionary size needed
                 str_cap = max(str_cap * 2, n_strings.value)
                 if str_cap > (1 << 24):
                     return None
                 continue
             if e_used == -4:
+                # e_needed is already <= max_slots (slot lists are
+                # truncated before the stride check)
                 e_cap = max(e_cap + 1, e_needed.value)
                 continue
             if e_used < 0:
@@ -312,6 +342,74 @@ class NativeFlattener:
             # copies, not views: a view pins the full str_cap buffers
             str_bytes=str_bytes[:V].copy(), dictv=dictv[:V].copy(),
         )
+
+    def flatten_packed(self, resources: list[dict] | None = None,
+                       max_slots: int = 16,
+                       requests: list[dict] | None = None,
+                       json_docs: bytes | None = None,
+                       n_docs: int | None = None,
+                       json_reqs: bytes | None = None):
+        """Flatten straight into the packed transfer form (PackedBatch),
+        or None on any failure. ``json_docs`` (a JSON array of documents,
+        e.g. the items of an apiserver list response) skips the Python
+        json.dumps — the scan regime's input is wire bytes, and the dumps
+        held the GIL for as long as the whole native parse took. Dict
+        input takes the PyObject direct-walk entry when available (no
+        serialization at all — json.dumps used to cost 3x the actual
+        parse+flatten for admission-sized batches), falling back to
+        dumps+parse on any unconvertible object."""
+        if json_docs is None and resources is not None and _pylib is not None:
+            out = self._flatten_packed_py(resources, requests, max_slots)
+            if out is not None:
+                return out
+            # fall through: serialize-then-parse handles what the direct
+            # walk rejected (non-finite floats, exotic types)
+        if json_docs is not None:
+            docs, B = json_docs, int(n_docs)
+            reqs = json_reqs
+        else:
+            B = len(resources)
+            try:
+                docs = json.dumps(resources).encode("utf-8")
+                reqs = (json.dumps(requests).encode("utf-8")
+                        if requests is not None else None)
+            except (TypeError, ValueError):
+                return None
+
+        def invoke(e_cap, e_needed, cells, bmeta, dictv, str_bytes,
+                   n_strings, str_cap):
+            return self._lib.ktpu_flatten_packed(
+                self._handle, docs, len(docs), reqs,
+                len(reqs) if reqs is not None else 0,
+                B, max_slots, e_cap, ctypes.byref(e_needed),
+                _ptr(cells), _ptr(bmeta), _ptr(dictv), _ptr(str_bytes),
+                ctypes.byref(n_strings), str_cap,
+            )
+
+        return self._packed_retry_loop(B, max_slots, invoke)
+
+    def _flatten_packed_py(self, resources: list[dict],
+                           requests: list[dict] | None,
+                           max_slots: int):
+        """PackedBatch via the PyObject direct-walk entry (GIL held,
+        zero serialization), or None when the walk can't express the
+        input (the caller then serializes)."""
+        if not isinstance(resources, list):
+            resources = list(resources)
+        if requests is not None and not isinstance(requests, list):
+            requests = list(requests)
+        B = len(resources)
+
+        def invoke(e_cap, e_needed, cells, bmeta, dictv, str_bytes,
+                   n_strings, str_cap):
+            return _pylib.ktpu_flatten_packed_py(
+                self._handle, resources, requests,
+                B, max_slots, e_cap, ctypes.byref(e_needed),
+                _ptr(cells), _ptr(bmeta), _ptr(dictv), _ptr(str_bytes),
+                ctypes.byref(n_strings), str_cap,
+            )
+
+        return self._packed_retry_loop(B, max_slots, invoke)
 
 
 def flatten_batch_fast(resources: list[dict], tensors: PolicyTensors,

@@ -15,8 +15,17 @@
 // prefix-presence masks, request-envelope and effective-namespace roots,
 // host-lane flags, and Go-style float stringification (utils/gofmt.py).
 //
-// C ABI only (consumed via ctypes; pybind11 is not in the image).
+// C ABI only (consumed via ctypes; pybind11 is not in the image). The
+// one Python-aware entry (ktpu_flatten_packed_py, walking live dicts to
+// skip json.dumps) is guarded by KTPU_NO_PYTHON for builds without
+// Python headers and is loaded via ctypes.PyDLL (GIL held).
 
+#ifndef KTPU_NO_PYTHON
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#endif
+
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -24,8 +33,10 @@
 #include <cstdlib>
 #include <charconv>
 #include <deque>
+#include <memory>
 #include <string>
 #include <string_view>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -1052,53 +1063,30 @@ struct PackedInterner {
 
 constexpr uint32_t ELEM0_CAP = 254;  // mirrors flatten.ELEM0_CAP
 
-}  // namespace
-
-extern "C" {
-
-// Flatten a batch straight into the packed transfer form
-// (flatten.PACKED_BATCH_ARRAYS): cells uint32 [B,P,e_cap,2], bmeta uint32
-// [B], dictv uint32 [str_cap,5], str_bytes uint8 [str_cap,L]. Same input
-// conventions and -1/-2/-3/-4 retry protocol as ktpu_flatten_batch.
-// Differences from the unpacked form are exactly the packed-lane caps:
-// a resource hosts when elem0 exceeds ELEM0_CAP or a numeric/duration
-// value lives on a string too long to intern (the cell lanes that carried
-// such values are gone; the CPU oracle re-walks the document instead).
-int ktpu_flatten_packed(
-    void* handle,
-    const char* docs, int64_t docs_len,
-    const char* reqs, int64_t reqs_len,
-    int n_docs, int max_slots, int e_cap, int32_t* e_needed,
-    uint32_t* cells, uint32_t* bmeta, uint32_t* dictv,
-    uint8_t* str_bytes,
-    int32_t* n_strings, int str_cap) {
-
-    Ctx* ctx = static_cast<Ctx*>(handle);
-    const int P = int(ctx->paths.size());
-    const int E = e_cap;
-    const int L = ctx->str_len_cap;
-
-    Arena arena;
-    ArrayStream doc_stream{Parser{docs, docs + docs_len, &arena}};
-    ArrayStream req_stream{Parser{reqs, reqs + (reqs ? reqs_len : 0), &arena}};
-
-    PackedInterner interner(L);
+// Per-document packed flatten: one instance per (sequential run | thread
+// shard), writing cells/bmeta rows for the documents it is handed and
+// interning into its own dictionary. Shared by the JSON-stream, threaded,
+// and PyObject entry points so the cell semantics exist exactly once.
+struct PackedCore {
+    Ctx* ctx;
+    int P, E, L, max_slots;
+    uint32_t* cells;        // global [n_docs, P, E, 2] base pointer
+    uint32_t* bmeta;        // global [n_docs]
+    PackedInterner interner;
     int e_used = 1;
     std::vector<Slot> slots;
     Value nseff_leaf;
-    nseff_leaf.t = Value::Str;
 
-    for (int b = 0; b < n_docs; ++b) {
-        arena.reset();
-        const Value* root = doc_stream.next();
-        if (!doc_stream.parser.ok) return -2;
-        if (root == nullptr) return -3;
-        const Value* env = nullptr;
-        if (reqs != nullptr) {
-            env = req_stream.next();
-            if (!req_stream.parser.ok) return -2;
-            if (env == nullptr) return -3;
-        }
+    PackedCore(Ctx* c, int e_cap, int max_slots_,
+               uint32_t* cells_, uint32_t* bmeta_)
+        : ctx(c), P(int(c->paths.size())), E(e_cap), L(c->str_len_cap),
+          max_slots(max_slots_), cells(cells_), bmeta(bmeta_),
+          interner(c->str_len_cap) {
+        nseff_leaf.t = Value::Str;
+    }
+
+    // 0 ok; -4 slot list exceeded the stride (*e_needed = required)
+    int doc(const Value* root, const Value* env, int b, int32_t* e_needed) {
         const bool env_nonempty =
             env != nullptr && env->t == Value::Obj && !env->obj.empty();
 
@@ -1231,13 +1219,13 @@ int ktpu_flatten_packed(
         bmeta[b] = uint32_t(kid + 1)
                    | (uint32_t(host ? 1 : 0) << 16)
                    | (uint32_t(1) << 17);                     // live
+        return 0;
     }
+};
 
-    if (!doc_stream.done) {
-        if (doc_stream.next() != nullptr || !doc_stream.done) return -3;
-        if (!doc_stream.parser.ok) return -2;
-    }
-
+// Emit the interner's dictionary into the output arrays; -1 on overflow.
+int emit_dict(const PackedInterner& interner, uint32_t* dictv,
+              uint8_t* str_bytes, int32_t* n_strings, int str_cap, int L) {
     const int V = int(interner.strings.size());
     *n_strings = V;
     if (V > str_cap) return -1;
@@ -1248,7 +1236,412 @@ int ktpu_flatten_packed(
         memcpy(dictv + size_t(v) * 5, interner.rows[size_t(v)].d,
                5 * sizeof(uint32_t));
     }
-    return e_used;
+    return 0;
+}
+
+// Byte ranges of the elements of a top-level JSON array (no validation of
+// the element bodies — the per-shard Parser does that). False: malformed
+// at the array level.
+bool scan_array_elements(
+    const char* p, const char* end,
+    std::vector<std::pair<const char*, const char*>>& out) {
+    auto ws = [](char c) {
+        return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+    };
+    while (p < end && ws(*p)) ++p;
+    if (p >= end || *p != '[') return false;
+    ++p;
+    while (true) {
+        while (p < end && ws(*p)) ++p;
+        if (p >= end) return false;
+        if (*p == ']') return true;
+        const char* start = p;
+        int depth = 0;
+        bool in_str = false;
+        while (p < end) {
+            char c = *p;
+            if (in_str) {
+                if (c == '\\') { p += 2; continue; }
+                if (c == '"') in_str = false;
+                ++p;
+            } else if (c == '"') { in_str = true; ++p; }
+            else if (c == '{' || c == '[') { ++depth; ++p; }
+            else if (c == '}' || c == ']') {
+                if (depth == 0) break;       // the array's own ']'
+                --depth; ++p;
+            } else if (c == ',' && depth == 0) break;
+            else ++p;
+        }
+        if (p > end) return false;
+        out.emplace_back(start, p);
+        while (p < end && ws(*p)) ++p;
+        if (p >= end) return false;
+        if (*p == ',') { ++p; continue; }
+        if (*p == ']') return true;
+        return false;
+    }
+}
+
+int flatten_threads() {
+    const char* env = getenv("KTPU_FLATTEN_THREADS");
+    if (env != nullptr && *env != '\0') {
+        int n = atoi(env);
+        if (n >= 1) return n < 64 ? n : 64;
+    }
+    unsigned hw = std::thread::hardware_concurrency();
+    int n = hw == 0 ? 1 : int(hw);
+    return n < 8 ? n : 8;
+}
+
+// Threaded packed flatten over pre-scanned element ranges. Byte-parity
+// with the sequential path: each shard interns locally in document order,
+// and the shard-order first-wins merge reproduces the sequential
+// first-appearance interning order exactly (all strings first seen in
+// shard k precede — in the same relative order — those first seen in
+// shard k+1, because shard k's documents do).
+int packed_parallel(
+    Ctx* ctx,
+    const std::vector<std::pair<const char*, const char*>>& doc_spans,
+    const std::vector<std::pair<const char*, const char*>>& req_spans,
+    bool have_reqs, int n_docs, int max_slots, int e_cap, int32_t* e_needed,
+    uint32_t* cells, uint32_t* bmeta, uint32_t* dictv, uint8_t* str_bytes,
+    int32_t* n_strings, int str_cap, int T) {
+
+    const int P = int(ctx->paths.size());
+    const int L = ctx->str_len_cap;
+    std::vector<std::unique_ptr<PackedCore>> cores;
+    cores.resize(size_t(T));
+    std::vector<int> shard_lo, shard_hi;
+    shard_lo.resize(size_t(T));
+    shard_hi.resize(size_t(T));
+    std::atomic<int> err{0};
+    std::atomic<int> need{0};
+    const int per = (n_docs + T - 1) / T;
+
+    auto shard_run = [&](int t) {
+        const int lo = t * per;
+        const int hi = lo + per < n_docs ? lo + per : n_docs;
+        shard_lo[size_t(t)] = lo;
+        shard_hi[size_t(t)] = hi;
+        auto core = std::make_unique<PackedCore>(
+            ctx, e_cap, max_slots, cells, bmeta);
+        Arena arena;
+        for (int b = lo; b < hi && err.load(std::memory_order_relaxed) == 0;
+             ++b) {
+            arena.reset();
+            Parser dp{doc_spans[size_t(b)].first,
+                      doc_spans[size_t(b)].second, &arena};
+            const Value* root = dp.parse();
+            if (!dp.ok) { err.store(-2); break; }
+            const Value* env = nullptr;
+            if (have_reqs) {
+                Parser rp{req_spans[size_t(b)].first,
+                          req_spans[size_t(b)].second, &arena};
+                env = rp.parse();
+                if (!rp.ok) { err.store(-2); break; }
+            }
+            int32_t en = 0;
+            int rc = core->doc(root, env, b, &en);
+            if (rc == -4) {
+                int cur = need.load();
+                while (en > cur && !need.compare_exchange_weak(cur, en)) {}
+                err.store(-4);
+                break;
+            }
+        }
+        cores[size_t(t)] = std::move(core);
+    };
+
+    std::vector<std::thread> threads;
+    threads.reserve(size_t(T - 1));
+    for (int t = 1; t < T; ++t) threads.emplace_back(shard_run, t);
+    shard_run(0);
+    for (auto& th : threads) th.join();
+
+    if (err.load() != 0) {
+        if (err.load() == -4) *e_needed = need.load();
+        return err.load();
+    }
+
+    // order-preserving first-wins merge of the shard dictionaries
+    PackedInterner global(L);
+    std::vector<std::vector<int32_t>> remap;
+    remap.resize(size_t(T));
+    int e_used = 1;
+    for (int t = 0; t < T; ++t) {
+        PackedInterner& loc = cores[size_t(t)]->interner;
+        if (cores[size_t(t)]->e_used > e_used) e_used = cores[size_t(t)]->e_used;
+        auto& rm = remap[size_t(t)];
+        rm.resize(loc.strings.size());
+        for (size_t i = 0; i < loc.strings.size(); ++i) {
+            const std::string& s = loc.strings[i];
+            auto it = global.index.find(s);
+            int32_t gid;
+            if (it == global.index.end()) {
+                gid = int32_t(global.strings.size());
+                global.index.emplace(s, gid);
+                global.strings.push_back(s);
+                // the row is a pure function of the string: carry it over
+                global.rows.push_back(loc.rows[i]);
+            } else {
+                gid = it->second;
+            }
+            rm[i] = gid;
+        }
+    }
+
+    // remap cell word0 (local sid + 1 -> global sid + 1), in parallel
+    auto remap_run = [&](int t) {
+        const auto& rm = remap[size_t(t)];
+        const size_t row_words = size_t(P) * size_t(e_cap) * 2;
+        for (int b = shard_lo[size_t(t)]; b < shard_hi[size_t(t)]; ++b) {
+            uint32_t* row = cells + size_t(b) * row_words;
+            for (size_t i = 0; i < row_words; i += 2) {
+                uint32_t w0 = row[i];
+                if (w0 != 0) row[i] = uint32_t(rm[size_t(w0 - 1)]) + 1;
+            }
+        }
+    };
+    threads.clear();
+    for (int t = 1; t < T; ++t) threads.emplace_back(remap_run, t);
+    remap_run(0);
+    for (auto& th : threads) th.join();
+
+    int rc = emit_dict(global, dictv, str_bytes, n_strings, str_cap, L);
+    return rc < 0 ? rc : e_used;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Flatten a batch straight into the packed transfer form
+// (flatten.PACKED_BATCH_ARRAYS): cells uint32 [B,P,e_cap,2], bmeta uint32
+// [B], dictv uint32 [str_cap,5], str_bytes uint8 [str_cap,L]. Same input
+// conventions and -1/-2/-3/-4 retry protocol as ktpu_flatten_batch.
+// Differences from the unpacked form are exactly the packed-lane caps:
+// a resource hosts when elem0 exceeds ELEM0_CAP or a numeric/duration
+// value lives on a string too long to intern (the cell lanes that carried
+// such values are gone; the CPU oracle re-walks the document instead).
+// Batches large enough to amortize a thread fan-out shard across
+// std::thread workers (KTPU_FLATTEN_THREADS overrides the count; the
+// result is byte-identical to the sequential path).
+int ktpu_flatten_packed(
+    void* handle,
+    const char* docs, int64_t docs_len,
+    const char* reqs, int64_t reqs_len,
+    int n_docs, int max_slots, int e_cap, int32_t* e_needed,
+    uint32_t* cells, uint32_t* bmeta, uint32_t* dictv,
+    uint8_t* str_bytes,
+    int32_t* n_strings, int str_cap) {
+
+    Ctx* ctx = static_cast<Ctx*>(handle);
+    const int L = ctx->str_len_cap;
+
+    const int T = flatten_threads();
+    if (T > 1 && n_docs >= 2 * T && n_docs >= 64) {
+        std::vector<std::pair<const char*, const char*>> doc_spans;
+        doc_spans.reserve(size_t(n_docs));
+        if (scan_array_elements(docs, docs + docs_len, doc_spans) &&
+            int(doc_spans.size()) == n_docs) {
+            std::vector<std::pair<const char*, const char*>> req_spans;
+            bool reqs_ok = true;
+            if (reqs != nullptr) {
+                req_spans.reserve(size_t(n_docs));
+                reqs_ok = scan_array_elements(
+                              reqs, reqs + reqs_len, req_spans) &&
+                          int(req_spans.size()) == n_docs;
+            }
+            if (reqs_ok) {
+                int threads = T;
+                if (n_docs / threads < 32) threads = n_docs / 32;
+                if (threads < 2) threads = 2;
+                return packed_parallel(
+                    ctx, doc_spans, req_spans, reqs != nullptr, n_docs,
+                    max_slots, e_cap, e_needed, cells, bmeta, dictv,
+                    str_bytes, n_strings, str_cap, threads);
+            }
+        }
+        // array-level scan failed: fall through to the sequential parser,
+        // which reports the precise -2/-3
+    }
+
+    Arena arena;
+    ArrayStream doc_stream{Parser{docs, docs + docs_len, &arena}};
+    ArrayStream req_stream{Parser{reqs, reqs + (reqs ? reqs_len : 0), &arena}};
+
+    PackedCore core(ctx, e_cap, max_slots, cells, bmeta);
+    for (int b = 0; b < n_docs; ++b) {
+        arena.reset();
+        const Value* root = doc_stream.next();
+        if (!doc_stream.parser.ok) return -2;
+        if (root == nullptr) return -3;
+        const Value* env = nullptr;
+        if (reqs != nullptr) {
+            env = req_stream.next();
+            if (!req_stream.parser.ok) return -2;
+            if (env == nullptr) return -3;
+        }
+        int rc = core.doc(root, env, b, e_needed);
+        if (rc != 0) return rc;
+    }
+
+    if (!doc_stream.done) {
+        if (doc_stream.next() != nullptr || !doc_stream.done) return -3;
+        if (!doc_stream.parser.ok) return -2;
+    }
+
+    int rc = emit_dict(core.interner, dictv, str_bytes, n_strings,
+                       str_cap, L);
+    return rc < 0 ? rc : core.e_used;
 }
 
 }  // extern "C"
+
+// ------------------------------------------------ PyObject direct walk
+
+#ifndef KTPU_NO_PYTHON
+
+namespace {
+
+// Python object -> Value tree, matching what parsing json.dumps(obj)
+// produces: dict insertion order, bool-before-int dispatch, repr() float
+// tokens (shortest round-trip, '.0' forced), str(int) integer tokens.
+// Unsupported types and non-finite floats fail the conversion (the JSON
+// path fails on Infinity/NaN tokens the same way) — the caller falls
+// back to the serialize-then-parse route.
+Value* py_to_value(PyObject* o, Arena* arena, bool* ok) {
+    Value* v = arena->alloc();
+    if (o == Py_None) { v->t = Value::Null; return v; }
+    if (o == Py_True || o == Py_False) {
+        v->t = Value::Bool;
+        v->b = o == Py_True;
+        return v;
+    }
+    if (PyLong_Check(o)) {
+        v->t = Value::Num;
+        int ovf = 0;
+        long long ll = PyLong_AsLongLongAndOverflow(o, &ovf);
+        if (ovf == 0 && !(ll == -1 && PyErr_Occurred())) {
+            char buf[24];
+            auto res = std::to_chars(buf, buf + sizeof buf, ll);
+            v->str.assign(buf, res.ptr);
+        } else {
+            PyErr_Clear();
+            PyObject* s = PyObject_Str(o);     // arbitrary precision
+            if (s == nullptr) { PyErr_Clear(); *ok = false; return v; }
+            Py_ssize_t n = 0;
+            const char* u = PyUnicode_AsUTF8AndSize(s, &n);
+            if (u == nullptr) { PyErr_Clear(); Py_DECREF(s); *ok = false; return v; }
+            v->str.assign(u, size_t(n));
+            Py_DECREF(s);
+        }
+        v->raw = v->str;
+        return v;
+    }
+    if (PyFloat_Check(o)) {
+        double d = PyFloat_AS_DOUBLE(o);
+        if (!std::isfinite(d)) { *ok = false; return v; }
+        v->t = Value::Num;
+        char* s = PyOS_double_to_string(d, 'r', 0, Py_DTSF_ADD_DOT_0, nullptr);
+        if (s == nullptr) { PyErr_Clear(); *ok = false; return v; }
+        v->str = s;
+        PyMem_Free(s);
+        v->raw = v->str;
+        return v;
+    }
+    if (PyUnicode_Check(o)) {
+        v->t = Value::Str;
+        Py_ssize_t n = 0;
+        const char* u = PyUnicode_AsUTF8AndSize(o, &n);
+        if (u == nullptr) { PyErr_Clear(); *ok = false; return v; }
+        v->str.assign(u, size_t(n));
+        return v;
+    }
+    if (PyDict_Check(o)) {
+        v->t = Value::Obj;
+        PyObject* key;
+        PyObject* val;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(o, &pos, &key, &val)) {
+            if (!PyUnicode_Check(key)) { *ok = false; return v; }
+            Py_ssize_t n = 0;
+            const char* u = PyUnicode_AsUTF8AndSize(key, &n);
+            if (u == nullptr) { PyErr_Clear(); *ok = false; return v; }
+            Value* child = py_to_value(val, arena, ok);
+            if (!*ok) return v;
+            v->obj.emplace_back(std::string(u, size_t(n)), child);
+        }
+        return v;
+    }
+    if (PyList_Check(o)) {
+        v->t = Value::Arr;
+        Py_ssize_t n = PyList_GET_SIZE(o);
+        v->arr.reserve(size_t(n));
+        for (Py_ssize_t i = 0; i < n; ++i) {
+            Value* child = py_to_value(PyList_GET_ITEM(o, i), arena, ok);
+            if (!*ok) return v;
+            v->arr.push_back(child);
+        }
+        return v;
+    }
+    if (PyTuple_Check(o)) {                    // json.dumps serializes as array
+        v->t = Value::Arr;
+        Py_ssize_t n = PyTuple_GET_SIZE(o);
+        v->arr.reserve(size_t(n));
+        for (Py_ssize_t i = 0; i < n; ++i) {
+            Value* child = py_to_value(PyTuple_GET_ITEM(o, i), arena, ok);
+            if (!*ok) return v;
+            v->arr.push_back(child);
+        }
+        return v;
+    }
+    *ok = false;
+    return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Packed flatten straight from live Python lists of dicts — no
+// json.dumps, no JSON parse. Loaded via ctypes.PyDLL (the GIL stays
+// held; the walk touches refcounted objects throughout). Same output
+// and -1/-4 retry protocol as ktpu_flatten_packed; -5 = an object the
+// JSON model can't express (caller falls back to the dumps path).
+int ktpu_flatten_packed_py(
+    void* handle, PyObject* docs, PyObject* reqs,
+    int n_docs, int max_slots, int e_cap, int32_t* e_needed,
+    uint32_t* cells, uint32_t* bmeta, uint32_t* dictv,
+    uint8_t* str_bytes,
+    int32_t* n_strings, int str_cap) {
+
+    Ctx* ctx = static_cast<Ctx*>(handle);
+    if (!PyList_Check(docs) || PyList_GET_SIZE(docs) != n_docs) return -3;
+    if (reqs != nullptr && reqs != Py_None &&
+        (!PyList_Check(reqs) || PyList_GET_SIZE(reqs) != n_docs)) return -3;
+    const bool have_reqs = reqs != nullptr && reqs != Py_None;
+
+    Arena arena;
+    PackedCore core(ctx, e_cap, max_slots, cells, bmeta);
+    for (int b = 0; b < n_docs; ++b) {
+        arena.reset();
+        bool ok = true;
+        const Value* root = py_to_value(PyList_GET_ITEM(docs, b), &arena, &ok);
+        if (!ok) return -5;
+        const Value* env = nullptr;
+        if (have_reqs) {
+            env = py_to_value(PyList_GET_ITEM(reqs, b), &arena, &ok);
+            if (!ok) return -5;
+        }
+        int rc = core.doc(root, env, b, e_needed);
+        if (rc != 0) return rc;
+    }
+    int rc = emit_dict(core.interner, dictv, str_bytes, n_strings,
+                       str_cap, ctx->str_len_cap);
+    return rc < 0 ? rc : core.e_used;
+}
+
+}  // extern "C"
+
+#endif  // KTPU_NO_PYTHON

@@ -147,6 +147,60 @@ def test_native_packed_json_input_identical(cps):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_pyobject_walk_matches_json_parse(cps):
+    """The PyObject direct-walk entry (no serialization) must be
+    byte-identical to serialize-then-parse for every lane class in the
+    corpus — including the unicode/host-lane and huge-int rows."""
+    import kyverno_tpu.models.native_flatten as nf
+
+    if not native_available() or nf._pylib is None:
+        pytest.skip("PyObject flatten entry unavailable")
+    ctx = nf._flattener_for(cps.tensors)
+    via_py = ctx._flatten_packed_py(_RESOURCES, None, 16)
+    assert via_py is not None
+    js = json.dumps(_RESOURCES).encode()
+    via_json = ctx.flatten_packed(json_docs=js, n_docs=len(_RESOURCES))
+    for name, a, b in zip(("cells", "bmeta", "str_bytes", "dictv"),
+                          via_py.packed_args(), via_json.packed_args()):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
+def test_pyobject_walk_nonfinite_float_falls_back(cps):
+    """Non-finite floats can't ride the direct walk (json.dumps would
+    emit Infinity, which the JSON grammar rejects) — the wrapper must
+    still return a usable batch via the pure-Python fallback, with the
+    resource on the host lane."""
+    bad = dict(_RESOURCES[0], spec=dict(_RESOURCES[0]["spec"],
+                                        replicas=float("inf")))
+    pb = flatten_packed_fast(cps.tensors, [bad])
+    assert pb is not None
+    assert (np.asarray(pb.bmeta)[0] >> 16) & 1 == 1   # host lane
+
+
+def test_threaded_flatten_byte_parity(cps, monkeypatch):
+    """The thread-sharded packed flatten (json_docs path, forced via
+    KTPU_FLATTEN_THREADS) must reproduce the sequential interning order
+    and every output byte."""
+    import kyverno_tpu.models.native_flatten as nf
+
+    if not native_available():
+        pytest.skip("native flattener unavailable")
+    resources = [_RESOURCES[i % len(_RESOURCES)] for i in range(300)]
+    # vary names so the dictionary grows across shard boundaries
+    resources = [dict(r, metadata=dict(r.get("metadata") or {},
+                                       name=f"r-{i}"))
+                 for i, r in enumerate(resources)]
+    js = json.dumps(resources).encode()
+    ctx = nf._flattener_for(cps.tensors)
+    monkeypatch.setenv("KTPU_FLATTEN_THREADS", "4")
+    thr = ctx.flatten_packed(json_docs=js, n_docs=len(resources))
+    monkeypatch.setenv("KTPU_FLATTEN_THREADS", "1")
+    seq = ctx.flatten_packed(json_docs=js, n_docs=len(resources))
+    for name, a, b in zip(("cells", "bmeta", "str_bytes", "dictv"),
+                          thr.packed_args(), seq.packed_args()):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), name
+
+
 def test_packed_eval_matches_unpacked(cps):
     fb = flatten_batch(_RESOURCES, cps.tensors)
     want = np.asarray(build_eval_fn(cps.tensors)(*fb.device_args()))
