@@ -232,37 +232,62 @@ def bench_config1(jax):
     lib_port = lib_httpd.server_address[1]
     lib_batcher.warmup(  # controller startup does this (server.py)
         PolicyType.VALIDATE_ENFORCE, "Pod", "default", make_pod(1))
-    try:
-        def lib_worker(out):
-            import socket
+    def run_burst(port, n_threads=16, per_thread=16):
+        """(seq_p50, p50, p99, req_per_s, n): one sequential warm pass,
+        then n_threads workers of per_thread requests each on persistent
+        keep-alive connections. Shared by the cached and nocache runs so
+        the comparison can never drift methodologically."""
+        import socket
 
-            c = http.client.HTTPConnection("127.0.0.1", lib_port, timeout=30)
+        def worker(out):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
             c.connect()
             c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            for _ in range(16):
+            for _ in range(per_thread):
                 t0 = time.perf_counter()
                 c.request("POST", VALIDATING_WEBHOOK_PATH, body, headers)
                 c.getresponse().read()
                 out.append((time.perf_counter() - t0) * 1e3)
             c.close()
 
-        lib_lats: list = []
-        lib_worker(lib_lats)        # sequential warm pass (oracle-routed)
-        seq_p50, _ = _percentiles(lib_lats)
-        lib_lats = []
-        threads = [threading.Thread(target=lib_worker, args=(lib_lats,))
-                   for _ in range(16)]
+        lats: list = []
+        worker(lats)                # sequential warm pass
+        seq_p50, _ = _percentiles(lats)
+        lats = []
+        workers = [threading.Thread(target=worker, args=(lats,))
+                   for _ in range(n_threads)]
         t0 = time.monotonic()
-        for t in threads:
+        for t in workers:
             t.start()
-        for t in threads:
+        for t in workers:
             t.join()
-        lib_burst_s = time.monotonic() - t0
-        lp50, lp99 = _percentiles(lib_lats)
+        burst_s = time.monotonic() - t0
+        p50_, p99_ = _percentiles(lats)
+        return seq_p50, p50_, p99_, round(len(lats) / burst_s), len(lats)
+
+    try:
+        seq_p50, lp50, lp99, lib_rps, lib_n = run_burst(lib_port)
         routing_lib = dict(lib_batcher.stats)
     finally:
         lib_server.stop()
         lib_batcher.stop()
+
+    # transparency run: the same burst with the result cache OFF measures
+    # the raw device-screen + direct-deny pipeline (every request pays
+    # routing + screen/oracle work; nothing is served from cache)
+    nc_batcher = AdmissionBatcher(lib_cache, result_cache_ttl_s=0.0)
+    nc_server = WebhookServer(policy_cache=lib_cache, client=FakeCluster(),
+                              admission_batcher=nc_batcher)
+    nc_httpd = nc_server.run(host="127.0.0.1", port=0)
+    nc_batcher.warmup(
+        PolicyType.VALIDATE_ENFORCE, "Pod", "default", make_pod(1))
+    try:
+        nc_seq_p50, ncp50, ncp99, nc_rps, nc_n = run_burst(
+            nc_httpd.server_address[1])
+        routing_nc = dict(nc_batcher.stats)
+    finally:
+        nc_server.stop()
+        nc_batcher.stop()
 
     return {
         "latency_ms_p50": p50,
@@ -274,11 +299,17 @@ def bench_config1(jax):
                   "req_per_s": round(len(burst_lats) / burst_s),
                   "routing": routing_small},
         "burst_library_250": {
-            "n": len(lib_lats), "concurrency": 16,
+            "n": lib_n, "concurrency": 16,
             "seq_latency_ms_p50": seq_p50,
             "latency_ms_p50": lp50, "latency_ms_p99": lp99,
-            "req_per_s": round(len(lib_lats) / lib_burst_s),
+            "req_per_s": lib_rps,
             "routing": routing_lib},
+        "burst_library_250_nocache": {
+            "n": nc_n, "concurrency": 16,
+            "seq_latency_ms_p50": nc_seq_p50,
+            "latency_ms_p50": ncp50, "latency_ms_p99": ncp99,
+            "req_per_s": nc_rps,
+            "routing": routing_nc},
         "path": "HTTP POST /validate (production handler, latency-routed)",
     }
 

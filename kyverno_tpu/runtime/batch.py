@@ -34,6 +34,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
@@ -82,7 +83,9 @@ class AdmissionBatcher:
                  probe_interval_s: float = 10.0,
                  cold_flush_fallback: bool = True,
                  circuit_timeout_threshold: int = 3,
-                 circuit_cooldown_s: float = 5.0):
+                 circuit_cooldown_s: float = 5.0,
+                 result_cache_ttl_s: float = 1.0,
+                 result_cache_max: int = 4096):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
@@ -106,6 +109,17 @@ class AdmissionBatcher:
         self._oracle_policy_cost = oracle_cost_init_s
         self._dispatch_cost = dispatch_cost_init_s
         self._savings_frac = 0.5
+        # HOST CPU seconds a flush burns (flatten + dispatch bookkeeping,
+        # measured with thread_time so tunnel waits don't count): the
+        # device lane's true cost on the contended resource. Wall
+        # dispatch time is mostly idle link wait — the GIL is released —
+        # so comparing it against oracle CPU time (as the round-4 model
+        # did) starves the device lane exactly when the oracle queue is
+        # longest (the 250-policy 16-way burst: 44 req/s, p99 955ms)
+        self._flush_cpu_cost = 0.003
+        # flushes currently submitted/running: scales the latency model
+        # (a new flush queues behind them on the link)
+        self._pending_flushes = 0
         # realized flush size: a dispatch only amortizes over the batch
         # that actually formed, not over the instantaneous concurrency
         self._batch_size_ema = 4.0
@@ -126,6 +140,16 @@ class AdmissionBatcher:
         self.circuit_cooldown_s = circuit_cooldown_s
         self.stats = {"oracle": 0, "device": 0, "probe": 0,
                       "clean": 0, "attention": 0}
+        # short-TTL screen-result cache: admission bursts are dominated by
+        # near-identical resources (a Deployment scaling N replicas
+        # submits N near-identical Pods), and the screen row is a pure
+        # function of (compiled policy set, resource bytes) — the same
+        # determinism that lets CLEAN admit without the oracle. Only
+        # device-answered rows cache; TTL bounds staleness and a policy
+        # change rotates the CompiledPolicySet identity out of every key.
+        self.result_cache_ttl_s = result_cache_ttl_s
+        self.result_cache_max = result_cache_max
+        self._result_cache: dict = {}
         # per-CompiledPolicySet shape buckets already compiled; weak keys
         # so dead policy generations vanish (an id()-keyed set could both
         # leak and misclassify a fresh compile after id reuse)
@@ -195,12 +219,45 @@ class AdmissionBatcher:
     def _device_favored(self, est_batch: int, n_policies: int) -> bool:
         # amortize over the batch size dispatches actually realize, not
         # the instantaneous concurrency (the window only captures what
-        # arrives within it); allow 1.5x headroom so the lane can grow
+        # arrives within it); allow 2x headroom so the lane can bootstrap
         eff_batch = min(float(est_batch),
-                        max(1.0, 1.5 * self._batch_size_ema))
-        saved = (eff_batch * n_policies * self._oracle_policy_cost
-                 * self._savings_frac)
-        return self._dispatch_cost + self.window_s < saved
+                        max(float(self.burst_threshold),
+                            2.0 * self._batch_size_ema))
+        # what the oracle alternative costs: these requests serialize on
+        # the CPU (one GIL), so the queue's wall-clock drain time IS the
+        # summed per-request cost
+        oracle_drain = eff_batch * n_policies * self._oracle_policy_cost
+        # CPU economics: the flush's host CPU (flatten + dispatch) must be
+        # cheaper than the oracle CPU it replaces. Wall dispatch time is
+        # NOT on this axis — the link wait holds no GIL.
+        cpu_won = oracle_drain * self._savings_frac > self._flush_cpu_cost
+        # latency: the device answer (behind any flushes already in
+        # flight) must beat the oracle queue's drain time, and fit the
+        # deadline budget
+        device_latency = (self._dispatch_cost * (1 + self._pending_flushes)
+                          + self.window_s)
+        lat_ok = device_latency < min(oracle_drain, SCREEN_DEADLINE_S)
+        return cpu_won and lat_ok
+
+    # batch-axis floor for admission flushes: every burst-sized batch
+    # (<= this) pads to ONE shape, so warmup's single compile covers the
+    # whole burst regime — without it, a 16-way burst's first flushes of
+    # 4/8 rows each hit a cold XLA bucket and fall back to the oracle
+    PAD_FLOOR = 16
+
+    @classmethod
+    def _pad_admission(cls, batch):
+        """Power-of-two bucket padding with the admission batch floor."""
+        from ..models.flatten import pad_packed, pad_to_buckets_packed
+        from dataclasses import replace
+
+        padded, n0 = pad_to_buckets_packed(batch)
+        if padded.cells.shape[0] < cls.PAD_FLOOR:
+            cells, bmeta, _ = pad_packed(
+                padded.cells, padded.bmeta, cls.PAD_FLOOR)
+            padded = replace(padded, n=cls.PAD_FLOOR, cells=cells,
+                             bmeta=bmeta)
+        return padded, n0
 
     def warmup(self, ptype, kind: str, namespace: str, resource: dict,
                batch_sizes: tuple = (1, 16)) -> None:
@@ -208,9 +265,8 @@ class AdmissionBatcher:
         prime the dispatch-cost EMA — the controller calls this at startup
         and after policy changes (the north star's 'precompiled policy
         tensor at controller start'), so the first real burst never pays
-        XLA compilation inline."""
-        from ..models.flatten import pad_to_buckets_packed
-
+        XLA compilation inline. With the admission pad floor, every size
+        in ``batch_sizes`` up to PAD_FLOOR lands on one compiled shape."""
         try:
             cps = self.policy_cache.compiled(ptype, kind, namespace)
         except Exception:
@@ -219,7 +275,7 @@ class AdmissionBatcher:
             return
         for b in batch_sizes:
             try:
-                batch, _ = pad_to_buckets_packed(
+                batch, _ = self._pad_admission(
                     cps.flatten_packed([resource] * b))
                 shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
                 cps.evaluate_device(batch)          # compile
@@ -233,10 +289,75 @@ class AdmissionBatcher:
                 self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
                 self._last_dispatch = time.monotonic()
 
+    # ------------------------------------------------------------- cache
+
+    def _cache_key(self, ptype, kind: str, namespace: str, resource: dict,
+                   env: dict | None = None):
+        """``env`` carries the request-identity fields rule outcomes can
+        depend on beyond the resource body (operation, userInfo,
+        oldObject): the ORACLE lane evaluates request.* conditions and
+        RBAC matches, so two admissions of the same resource by
+        different users must never share a cache row. Cluster-state
+        context (ConfigMap/APICall) is bounded by the TTL only — the
+        same staleness window an informer-backed lookup has. The policy
+        generation counter keys the policy-set identity (NOT id(cps):
+        cache entries outlive the compiled set, and a recycled address
+        would serve the old generation's verdicts)."""
+        try:
+            import hashlib
+            import json as _json
+
+            digest = hashlib.blake2b(
+                _json.dumps([resource, env]).encode("utf-8"),
+                digest_size=16).digest()
+            generation = getattr(self.policy_cache, "generation", 0)
+            return (generation, int(ptype), kind, namespace, digest)
+        except (TypeError, ValueError):
+            return None
+
+    def _cache_store(self, cache_key, status, row) -> None:
+        """Caller holds self._lock."""
+        if len(self._result_cache) >= self.result_cache_max:
+            cutoff = time.monotonic()
+            self._result_cache = {
+                k: v for k, v in self._result_cache.items()
+                if v[0] > cutoff}
+            if len(self._result_cache) >= self.result_cache_max:
+                self._result_cache.clear()
+        self._result_cache[cache_key] = (
+            time.monotonic() + self.result_cache_ttl_s, status, row)
+
+    def decision_key(self, ptype, kind: str, namespace: str, resource: dict,
+                     env: dict | None = None):
+        """Stable cache key for this admission's enforce decision (the
+        webhook's decision cache shares the batcher's keying and TTL
+        semantics); None when caching is off or the input is unkeyable."""
+        if self.result_cache_ttl_s <= 0:
+            return None
+        return self._cache_key(ptype, kind, namespace, resource, env)
+
+    def store_result(self, ptype, kind: str, namespace: str, resource: dict,
+                     row, env: dict | None = None) -> None:
+        """Cache a verdict row produced by the ORACLE lane (the webhook
+        calls this after a full or hybrid run): the decision is the same
+        pure function of (policy set, resource) the device rows are, so
+        a warm system serves repeat admissions at cache speed through
+        either lane. Same TTL bound; a policy change bumps the cache
+        generation out of every key."""
+        if self.result_cache_ttl_s <= 0:
+            return
+        key = self._cache_key(ptype, kind, namespace, resource, env)
+        if key is None:
+            return
+        clean = all(v in (Verdict.PASS, Verdict.SKIP) for _, _, v in row)
+        with self._lock:
+            self._cache_store(key, CLEAN if clean else ATTENTION, row)
+
     # ------------------------------------------------------------ enqueue
 
     def screen(self, ptype, kind: str, namespace: str, resource: dict,
-               timeout_s: float = SCREEN_DEADLINE_S):
+               timeout_s: float = SCREEN_DEADLINE_S,
+               env: dict | None = None):
         """Returns (CLEAN | ATTENTION | ORACLE, [(policy, rule, Verdict), ...]).
 
         ORACLE means "the device does not pay for this request — evaluate
@@ -250,6 +371,18 @@ class AdmissionBatcher:
             return ATTENTION, []
         if not cps.policies:
             return CLEAN, []
+        cache_key = None
+        if self.result_cache_ttl_s > 0:
+            cache_key = self._cache_key(ptype, kind, namespace,
+                                        resource, env)
+            if cache_key is not None:
+                hit = self._result_cache.get(cache_key)
+                if hit is not None and hit[0] > time.monotonic():
+                    with self._lock:
+                        self.stats["cache"] = self.stats.get("cache", 0) + 1
+                        self.stats["clean" if hit[1] == CLEAN
+                                   else "attention"] += 1
+                    return hit[1], hit[2]
         fut: Future = Future()
         now = time.monotonic()
         with self._lock:
@@ -300,17 +433,30 @@ class AdmissionBatcher:
             self._lock.notify()
             # bound the wrong-way cost: if the dispatch estimate turns out
             # optimistic, bail to the oracle after ~4x the expected RTT
-            # instead of eating the full deadline budget. Cold sets keep
-            # the full budget — their first flush legitimately pays XLA
-            # compilation
+            # (scaled by the flushes already queued on the link) instead
+            # of eating the full deadline budget. Cold sets keep the full
+            # budget — their first flush legitimately pays XLA compilation
             adaptive = bool(self._seen_shapes.get(cps))
+            deadline_budget = timeout_s
             if adaptive:
                 timeout_s = min(timeout_s,
                                 max(0.05, 4 * self._dispatch_cost
-                                    + self.window_s))
+                                    + self.window_s)
+                                * (1 + self._pending_flushes))
         wait_start = time.monotonic()
         try:
-            status, row, device_answered = fut.result(timeout=timeout_s)
+            try:
+                status, row, device_answered = fut.result(timeout=timeout_s)
+            except FuturesTimeout:
+                # the adaptive deadline expired — but if OUR flush has
+                # already started (flatten/dispatch under way), bailing
+                # now wastes the in-flight work AND re-serializes this
+                # request onto the oracle the burst is already choking;
+                # keep waiting up to the full deadline budget instead
+                remaining = deadline_budget - (time.monotonic() - wait_start)
+                if not getattr(fut, "ktpu_started", False) or remaining <= 0:
+                    raise
+                status, row, device_answered = fut.result(timeout=remaining)
         except Exception:
             elapsed = time.monotonic() - wait_start
             with self._lock:
@@ -343,6 +489,8 @@ class AdmissionBatcher:
                 # healthy; cold-fallback and error resolutions do not
                 self._consecutive_timeouts = 0
                 self._timed_out_flushes.clear()
+                if cache_key is not None:
+                    self._cache_store(cache_key, status, row)
             self.stats["clean" if status == CLEAN else "attention"] += 1
         return status, row
 
@@ -373,20 +521,33 @@ class AdmissionBatcher:
                 self._buckets = {k: b for k, b in self._buckets.items()
                                  if b.items}
             for cps, items, is_probe in work:
-                self._flush_pool.submit(self._flush, cps, items, is_probe)
+                with self._lock:
+                    self._pending_flushes += 1
+                self._flush_pool.submit(self._flush_tracked, cps, items,
+                                        is_probe)
+
+    def _flush_tracked(self, cps, items, is_probe: bool) -> None:
+        try:
+            self._flush(cps, items, is_probe)
+        finally:
+            with self._lock:
+                self._pending_flushes -= 1
 
     def _flush(self, cps, items, is_probe: bool = False) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
-            from ..models.flatten import pad_to_buckets_packed
-
+            for _, fut in items:
+                # waiters whose adaptive deadline expires while this
+                # flush is under way keep waiting (screen() checks this)
+                fut.ktpu_started = True
             resources = [r for r, _ in items]
             t0 = time.monotonic()
-            # bucket the batch shape so XLA compiles once per bucket, not
-            # once per distinct admission batch
-            batch, _ = pad_to_buckets_packed(cps.flatten_packed(resources))
+            cpu0 = time.thread_time()
+            # bucket the batch shape (pow2 + admission floor) so XLA
+            # compiles once per bucket, not once per admission batch
+            batch, _ = self._pad_admission(cps.flatten_packed(resources))
             shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
             with self._lock:
                 cold = shape_key not in self._seen_shapes.setdefault(cps,
@@ -401,6 +562,7 @@ class AdmissionBatcher:
                         fut.set_result((ATTENTION, [], False))
             verdicts = np.asarray(cps.evaluate_device(batch))
             dt = time.monotonic() - t0
+            cpu_dt = time.thread_time() - cpu0
             with self._lock:
                 # a cold-entry flush paid (or was blocked behind) XLA
                 # compilation — a one-time cost, not the steady-state
@@ -410,6 +572,10 @@ class AdmissionBatcher:
                 # either, even though the shape is in the set by now
                 if not cold:
                     self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
+                    # host CPU actually burned (thread_time: link waits
+                    # excluded) — the cost-model side of the device lane
+                    self._flush_cpu_cost += 0.3 * (cpu_dt
+                                                   - self._flush_cpu_cost)
                 else:
                     self._seen_shapes[cps].add(shape_key)
                 if not is_probe:

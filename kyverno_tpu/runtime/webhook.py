@@ -101,6 +101,10 @@ class WebhookServer:
         self.registry = registry or metrics_mod.registry()
         self.audit_handler = AuditHandler(self._process_audit)
         self.last_request_time = time.time()
+        # decision cache: keyed/TTL'd by the admission batcher's rules
+        # (policy generation + resource + requester digest)
+        self._decision_cache: dict = {}
+        self._decision_lock = threading.Lock()
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------ dispatch
@@ -290,7 +294,7 @@ class WebhookServer:
         return _admission_response(uid, True, patches=patches)
 
     def _record_screen_results(self, row, resource: dict, kind: str,
-                               request: dict) -> None:
+                               request: dict) -> list:
         """Metrics + report rows for a device-screened admission, matching
         what the oracle loop records for passing resources."""
         from ..engine.response import (
@@ -303,16 +307,18 @@ class WebhookServer:
         )
 
         meta = resource.get("metadata") or {}
+        recorded: list[tuple] = []
         per_policy: dict[str, EngineResponse] = {}
         for policy_name, rule_name, verdict in row:
             status = batch_mod.verdict_to_status(verdict)
             if status is None:
                 continue
+            recorded.append((policy_name, rule_name, status.value))
             metrics_mod.record_policy_results(
                 self.registry, policy_name, rule_name, status.value,
                 validation_mode="enforce", resource_kind=kind,
                 request_operation=request.get("operation", "CREATE"))
-            if self.report_gen is None:
+            if self.report_gen is None and self.event_gen is None:
                 continue
             resp = per_policy.get(policy_name)
             if resp is None:
@@ -329,6 +335,43 @@ class WebhookServer:
         for resp in per_policy.values():
             if self.report_gen is not None:
                 self.report_gen.add(resp)
+            # device-recorded failures emit the same violation events the
+            # oracle loop would (policy_violation events in the reference)
+            if self.event_gen is not None and not resp.successful:
+                self.event_gen.add(*events_for_engine_response(resp))
+        return recorded
+
+    def _device_deny_messages(self, policy, rule_verdicts):
+        """Deny messages for a policy every one of whose flagged screen
+        cells is a device FAIL on a rule with a *static* validation
+        message — or None when any cell needs the oracle (HOST/ERROR
+        verdicts, ``{{..}}``/``$(..)`` in the message). The device
+        lattice already admits on all-PASS rows, so its FAIL on a
+        device-compiled rule carries the same authority; the oracle
+        would add only the failing path to the message text."""
+        from ..models import Verdict
+
+        if policy is None:
+            return None
+        rules = {r.name: r for r in policy.spec.rules}
+        msgs = []
+        for rname, v in rule_verdicts:
+            if v in (Verdict.PASS, Verdict.SKIP):
+                continue
+            if v is not Verdict.FAIL:
+                return None
+            rule = rules.get(rname)
+            if rule is None:
+                return None
+            msg = rule.validation.message or ""
+            if "{{" in msg or "$(" in msg:
+                return None
+            if msg:
+                text = f"validation error: {msg} Rule {rname} failed"
+            else:
+                text = f"validation error: rule {rname} failed"
+            msgs.append(f"policy {policy.name}/{rname}: {text}")
+        return msgs or None
 
     def _resource_validation(self, request: dict) -> dict:
         """server.go:476 resourceValidation: enforce inline, audit async,
@@ -341,6 +384,45 @@ class WebhookServer:
         enforce = self.policy_cache.get_policies(
             PolicyType.VALIDATE_ENFORCE, kind, namespace)
         blocked_msgs: list[str] = []
+        metric_rows: list[tuple] = []
+
+        # request-identity fields the cache key must cover: outcomes can
+        # depend on who asks and how, not just the resource body
+        screen_env = {"operation": request.get("operation"),
+                      "userInfo": request.get("userInfo"),
+                      "oldObject": request.get("oldObject")}
+
+        # decision cache: a repeat of an identical admission (same policy
+        # generation, resource bytes, requester identity) within the TTL
+        # replays the decision + metrics without touching either engine
+        # lane. Report/event emission is skipped — for an identical
+        # (resource, outcomes) pair the aggregates are unchanged — while
+        # the semantically required side effects (audit queue, generate
+        # policies) still run below. Cluster-state context staleness is
+        # bounded by the TTL, the same window an informer lookup has.
+        decision_key = None
+        if enforce and self.admission_batcher is not None:
+            decision_key = self.admission_batcher.decision_key(
+                PolicyType.VALIDATE_ENFORCE, kind, namespace, resource,
+                env=screen_env)
+            hit = (self._decision_cache.get(decision_key)
+                   if decision_key is not None else None)
+            if hit is not None and hit[0] > time.monotonic():
+                _, allowed, message, rows = hit
+                for pn, rn, sv in rows:
+                    metrics_mod.record_policy_results(
+                        self.registry, pn, rn, sv,
+                        validation_mode="enforce", resource_kind=kind,
+                        request_operation=request.get("operation", "CREATE"))
+                self.admission_batcher.stats["decision_cache"] = (
+                    self.admission_batcher.stats.get("decision_cache", 0) + 1)
+                if not allowed:
+                    return _admission_response(uid, False, message)
+                if self.policy_cache.get_policies(
+                        PolicyType.VALIDATE_AUDIT, kind, namespace):
+                    self.audit_handler.add(request)
+                self._apply_generate_policies(request)
+                return _admission_response(uid, True)
 
         # device screen (runtime/batch.py): micro-batched TPU evaluation;
         # an all-green row admits without touching the CPU engine, anything
@@ -349,29 +431,44 @@ class WebhookServer:
         screen_row: list = []
         if enforce and self.admission_batcher is not None:
             status, row = self.admission_batcher.screen(
-                PolicyType.VALIDATE_ENFORCE, kind, namespace, resource)
+                PolicyType.VALIDATE_ENFORCE, kind, namespace, resource,
+                env=screen_env)
             if status == batch_mod.CLEAN:
                 screened_clean = True
-                self._record_screen_results(row, resource, kind, request)
+                metric_rows += self._record_screen_results(
+                    row, resource, kind, request)
                 self.admission_batcher.note_screen_savings(1.0)
             elif status == batch_mod.ATTENTION and row:
                 screen_row = row
 
         if enforce and not screened_clean:
             # rule-level hybrid merge: policies the device already cleared
-            # are recorded from the screen row; only policies with a
-            # FAIL/ERROR/HOST cell pay the CPU oracle (for faithful
-            # messages and context-dependent semantics)
+            # are recorded from the screen row; a policy whose flagged
+            # cells are all device FAILs with *static* messages is denied
+            # straight from the verdicts (the lattice is the same
+            # authority that admits CLEAN rows — the oracle would add
+            # only the failing path to the message); only HOST/ERROR
+            # cells and variable messages pay the CPU oracle
             run_policies = enforce
             if screen_row:
                 from ..models import Verdict
 
                 bad = {p for p, _, v in screen_row
                        if v not in (Verdict.PASS, Verdict.SKIP)}
-                self._record_screen_results(
-                    [t for t in screen_row if t[0] not in bad],
+                by_name = {p.name: p for p in enforce}
+                direct: set = set()
+                for pname in bad:
+                    msgs = self._device_deny_messages(
+                        by_name.get(pname),
+                        [(r, v) for p, r, v in screen_row if p == pname])
+                    if msgs is None:
+                        continue            # needs the oracle
+                    direct.add(pname)
+                    blocked_msgs += msgs
+                metric_rows += self._record_screen_results(
+                    [t for t in screen_row if t[0] not in bad - direct],
                     resource, kind, request)
-                run_policies = [p for p in enforce if p.name in bad]
+                run_policies = [p for p in enforce if p.name in bad - direct]
             oracle_t0 = time.monotonic()
             # multicore lane: cluster-independent policies can evaluate in
             # a worker process (runtime/oracle_pool.py) — the GIL
@@ -388,6 +485,8 @@ class WebhookServer:
                     responses.append(engine_validate(pctx))
             for policy, resp in zip(run_policies, responses):
                 for rule in resp.policy_response.rules:
+                    metric_rows.append(
+                        (policy.name, rule.name, rule.status.value))
                     metrics_mod.record_policy_results(
                         self.registry, policy.name, rule.name,
                         rule.status.value,
@@ -410,13 +509,42 @@ class WebhookServer:
                 else:
                     self.admission_batcher.note_oracle_cost(
                         dt, len(run_policies))
+            if self.admission_batcher is not None:
+                # the decision is the same pure function of (policy set,
+                # resource) either lane computes — cache the merged verdict
+                # row so a repeat admission (deployment scale-up, retries)
+                # is served at cache speed regardless of which lane ran
+                from ..models import Verdict as _V
+
+                status_to_v = {RuleStatus.PASS: _V.PASS,
+                               RuleStatus.SKIP: _V.SKIP,
+                               RuleStatus.FAIL: _V.FAIL,
+                               RuleStatus.ERROR: _V.ERROR}
+                oracle_names = {p.name for p in run_policies}
+                full_row = [t for t in screen_row
+                            if t[0] not in oracle_names]
+                cacheable = True
+                for policy, resp in zip(run_policies, responses):
+                    for rule in resp.policy_response.rules:
+                        v = status_to_v.get(rule.status)
+                        if v is None:          # WARN etc.: don't cache
+                            cacheable = False
+                            break
+                        full_row.append((policy.name, rule.name, v))
+                if cacheable:
+                    self.admission_batcher.store_result(
+                        PolicyType.VALIDATE_ENFORCE, kind, namespace,
+                        resource, full_row, env=screen_env)
 
         # a blocked request is returned BEFORE audit/generate side effects
         # (server.go:553-563)
         if blocked_msgs:
-            return _admission_response(
-                uid, False, "resource blocked due to policy violations:\n"
-                + "\n".join(blocked_msgs))
+            message = ("resource blocked due to policy violations:\n"
+                       + "\n".join(blocked_msgs))
+            self._decision_store(decision_key, False, message, metric_rows)
+            return _admission_response(uid, False, message)
+
+        self._decision_store(decision_key, True, "", metric_rows)
 
         # async audit (server.go:559)
         if self.policy_cache.get_policies(PolicyType.VALIDATE_AUDIT, kind, namespace):
@@ -425,6 +553,29 @@ class WebhookServer:
         # generate policies -> GenerateRequest documents (server.go:562)
         self._apply_generate_policies(request)
         return _admission_response(uid, True)
+
+    def _decision_store(self, decision_key, allowed: bool, message: str,
+                        metric_rows: list) -> None:
+        if decision_key is None or self.admission_batcher is None:
+            return
+        # WARN (audit-mode downgrades) and other exotic statuses carry
+        # per-request semantics — don't cache those decisions
+        if any(sv not in ("pass", "fail", "skip", "error")
+               for _, _, sv in metric_rows):
+            return
+        ttl = self.admission_batcher.result_cache_ttl_s
+        if ttl <= 0:
+            return
+        with self._decision_lock:
+            if len(self._decision_cache) >= 4096:
+                cutoff = time.monotonic()
+                self._decision_cache = {
+                    k: v for k, v in self._decision_cache.items()
+                    if v[0] > cutoff}
+                if len(self._decision_cache) >= 4096:
+                    self._decision_cache.clear()
+            self._decision_cache[decision_key] = (
+                time.monotonic() + ttl, allowed, message, metric_rows)
 
     def _pool_oracle(self, policies, resource: dict, request: dict,
                      namespace: str):

@@ -45,6 +45,9 @@ def make_batcher(burst_threshold=1, **kw):
     kw.setdefault("dispatch_cost_init_s", 0.0)
     kw.setdefault("oracle_cost_init_s", 1.0)
     kw.setdefault("cold_flush_fallback", False)
+    # cache behavior is tested explicitly in TestScreenResultCache;
+    # everything else wants each screen to really reach the device
+    kw.setdefault("result_cache_ttl_s", 0.0)
     cache = PolicyCache()
     cache.add(load_policy(ENFORCE))
     return AdmissionBatcher(cache, window_s=0.002,
@@ -332,11 +335,66 @@ class TestWebhookScreenPath:
             assert out["response"]["allowed"] is False
             assert "latest tag not allowed" in (
                 out["response"]["status"]["message"])
-            # only the failing policy hit the oracle; require-name was
-            # cleared by the device screen
-            assert ran == ["disallow-latest-tag"]
-            # ...and its PASS was still recorded
-            assert "require-name" in server.registry.expose()
+            # the failing rule's message is static, so the deny comes
+            # straight from the device verdicts — NO oracle at all;
+            # require-name was cleared by the screen row
+            assert ran == []
+            # ...and both results were still recorded
+            exposed = server.registry.expose()
+            assert "require-name" in exposed
+            assert "disallow-latest-tag" in exposed
+        finally:
+            webhook_mod.engine_validate = orig_validate
+            batcher.stop()
+
+    def test_variable_message_fail_still_runs_oracle(self):
+        # a failing rule whose message needs {{substitution}} cannot be
+        # denied from the device row — the oracle owns the message
+        import kyverno_tpu.runtime.webhook as webhook_mod
+
+        varmsg = {
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "varmsg-latest"},
+            "spec": {
+                "validationFailureAction": "enforce",
+                "rules": [{
+                    "name": "no-latest",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {
+                        "message":
+                            "{{ request.object.metadata.name }} uses latest",
+                        "pattern": {"spec": {"containers": [
+                            {"image": "!*:latest"}]}},
+                    },
+                }],
+            },
+        }
+        cache = PolicyCache()
+        cache.add(load_policy(varmsg))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        ran = []
+        orig_validate = webhook_mod.engine_validate
+
+        def counting(pctx):
+            ran.append(pctx.policy.name)
+            return orig_validate(pctx)
+
+        webhook_mod.engine_validate = counting
+        try:
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:latest")))
+            assert out["response"]["allowed"] is False
+            # the oracle ran (for the substituted message)...
+            assert ran == ["varmsg-latest"]
+            # ...and produced the substituted text, not the template
+            msg = out["response"]["status"]["message"]
+            assert "{{" not in msg
         finally:
             webhook_mod.engine_validate = orig_validate
             batcher.stop()
@@ -389,4 +447,123 @@ class TestCircuitBreaker:
                                        "default", pod("nginx:1.21"))
             assert status == ORACLE
         finally:
+            batcher.stop()
+
+
+class TestScreenResultCache:
+    def test_identical_resource_hits_cache(self):
+        batcher, _ = make_batcher(result_cache_ttl_s=5.0)
+        try:
+            first = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", pod("nginx:latest"))
+            second = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                    "default", pod("nginx:latest"))
+            assert second == first
+            assert batcher.stats.get("cache", 0) == 1
+            # a different resource misses
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                           "default", pod("nginx:1.21"))
+            assert batcher.stats.get("cache", 0) == 1
+        finally:
+            batcher.stop()
+
+    def test_cache_expires(self):
+        import time
+
+        batcher, _ = make_batcher(result_cache_ttl_s=0.05)
+        try:
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                           "default", pod("nginx:latest"))
+            time.sleep(0.08)
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                           "default", pod("nginx:latest"))
+            assert batcher.stats.get("cache", 0) == 0
+        finally:
+            batcher.stop()
+
+    def test_policy_change_rotates_cache_key(self):
+        # a recompile changes the CompiledPolicySet identity, so stale
+        # rows can never serve a new policy generation
+        batcher, cache = make_batcher(result_cache_ttl_s=60.0)
+        try:
+            s1, _ = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", pod("nginx:latest"))
+            from kyverno_tpu.api.load import load_policy as _lp
+
+            cache.add(_lp({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": "second"},
+                "spec": {"validationFailureAction": "enforce", "rules": [{
+                    "name": "r2",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": "m",
+                                 "pattern": {"metadata": {"name": "?*"}}},
+                }]},
+            }))
+            s2, row2 = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                      "default", pod("nginx:latest"))
+            assert batcher.stats.get("cache", 0) == 0   # no stale hit
+            assert {p for p, _, _ in row2} >= {"second"}
+        finally:
+            batcher.stop()
+
+    def test_request_identity_keys_the_cache(self):
+        # same resource, different requester -> must not share a row
+        # (oracle-lane outcomes can depend on userInfo/operation)
+        batcher, _ = make_batcher(result_cache_ttl_s=60.0)
+        try:
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:latest"),
+                           env={"operation": "CREATE",
+                                "userInfo": {"username": "alice"}})
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:latest"),
+                           env={"operation": "CREATE",
+                                "userInfo": {"username": "bob"}})
+            assert batcher.stats.get("cache", 0) == 0
+            # identical identity DOES hit
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:latest"),
+                           env={"operation": "CREATE",
+                                "userInfo": {"username": "alice"}})
+            assert batcher.stats.get("cache", 0) == 1
+        finally:
+            batcher.stop()
+
+    def test_oracle_lane_results_populate_cache(self):
+        # a webhook admission that ran the ORACLE lane seeds the cache:
+        # the repeat admission is served without any engine work
+        import kyverno_tpu.runtime.webhook as webhook_mod
+
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        batcher = AdmissionBatcher(cache, window_s=0.002,
+                                   burst_threshold=100,   # force ORACLE
+                                   result_cache_ttl_s=60.0)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        ran = []
+        orig_validate = webhook_mod.engine_validate
+
+        def counting(pctx):
+            ran.append(pctx.policy.name)
+            return orig_validate(pctx)
+
+        webhook_mod.engine_validate = counting
+        try:
+            out1 = server.handle(VALIDATING_WEBHOOK_PATH,
+                                 review(pod("nginx:latest")))
+            assert out1["response"]["allowed"] is False
+            assert ran == ["disallow-latest-tag"]   # oracle ran once
+            out2 = server.handle(VALIDATING_WEBHOOK_PATH,
+                                 review(pod("nginx:latest")))
+            assert out2["response"]["allowed"] is False
+            # repeat was served from cache (the webhook's decision cache
+            # sits above the screen-row cache) — no second oracle run
+            assert ran == ["disallow-latest-tag"]
+            assert batcher.stats.get("decision_cache", 0) == 1
+            assert out2["response"]["status"]["message"] == (
+                out1["response"]["status"]["message"])
+        finally:
+            webhook_mod.engine_validate = orig_validate
             batcher.stop()
