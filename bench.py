@@ -497,13 +497,24 @@ def bench_config5(jax):
     """Background-scan replay: 1M-resource snapshot through the full
     pipeline — native flatten of chunk N+1 overlapping the single-blob
     transfer + device eval of chunk N, with per-rule counts reduced on
-    device (readback is bytes, not the [B, R] verdict matrix)."""
+    device (readback is bytes, not the [B, R] verdict matrix).
+
+    Scanner-faithful semantics: policies with ``background: false`` are
+    excluded exactly as BackgroundScanner does (runtime/background.py:71,
+    mirroring canBackgroundProcess, pkg/policy/policy_controller.go:181)
+    — round 4 ran select-secrets (apiCall context, background: false),
+    which flagged every row host-lane without ever paying to resolve it.
+    Any HOST rows that remain are now resolved through the batched
+    oracle INSIDE the timed region, and the device-only vs resolved
+    timings are reported separately."""
     from kyverno_tpu.api.load import load_policies_from_path
     from kyverno_tpu.models import CompiledPolicySet
     from kyverno_tpu.ops.eval import build_scan_fn_blob
 
-    cps = CompiledPolicySet(
-        load_policies_from_path("/root/reference/test/best_practices/"))
+    all_policies = load_policies_from_path(
+        "/root/reference/test/best_practices/")
+    policies = [p for p in all_policies if p.spec.background]
+    cps = CompiledPolicySet(policies)
     n_rules = int(cps.tensors.n_rules)
     scan_fn = build_scan_fn_blob(cps.tensors)
 
@@ -537,33 +548,66 @@ def bench_config5(jax):
     # INSIDE the timed region — tunnel backends can report
     # block_until_ready before execution finishes, so only a real D2H
     # proves the work is done
-    def one_scan() -> tuple[float, int, int]:
+    def one_scan() -> tuple[float, float, int, int]:
+        """(total_s, device_s, fail_cells, host_rows) — host-flagged rows
+        are resolved INSIDE the timed region: the kernel counts only
+        non-host rows, and every flagged row's full verdict row comes
+        from the CPU oracle (models/engine.py resolve_host_cells), the
+        same work BackgroundScanner.scan pays. No device re-eval, so
+        nothing compiles in the timed region; the host maps stack on
+        device and read back in ONE transfer (the tunnel charges ~145ms
+        per array); flagged documents regenerate from the synthetic
+        corpus instead of re-parsing a whole chunk's JSON."""
+        from kyverno_tpu.models import Verdict
+
         t0 = time.monotonic()
-        acc_fails = acc_host = None
+        acc_fails = None
+        host_maps = []                 # device-resident [B] bool per chunk
         with concurrent.futures.ThreadPoolExecutor(max_workers=2) as ex:
             for blob, shp in ex.map(flatten_chunk, snapshots):
                 f, _, h = scan_fn(blob, *shp)
-                hc = h.sum()
+                host_maps.append(h)
                 acc_fails = f if acc_fails is None else acc_fails + f
-                acc_host = hc if acc_host is None else acc_host + hc
         fails = int(np.asarray(acc_fails).sum())  # forces the whole chain
-        host_rows = int(acc_host)
-        return time.monotonic() - t0, fails, host_rows
+        acc_host = host_maps[0].sum()
+        for h in host_maps[1:]:
+            acc_host = acc_host + h.sum()
+        host_rows = int(np.asarray(acc_host))     # scalar readback
+        device_s = time.monotonic() - t0
+        if host_rows:
+            # only now pull the bitmaps — ONE stacked transfer, and only
+            # when there is something to resolve
+            host_all = np.asarray(jax.numpy.concatenate(host_maps))
+            n_r = int(cps.tensors.n_rules)
+            for c in range(n_chunks):
+                idx = np.flatnonzero(host_all[c * chunk:(c + 1) * chunk])
+                if not idx.size:
+                    continue
+                flagged = [make_pod(c * chunk + int(i)) for i in idx]
+                verdicts = np.full((len(flagged), n_r),
+                                   int(Verdict.HOST), dtype=np.int32)
+                cps.resolve_host_cells(flagged, verdicts)
+                fails += int((verdicts == Verdict.FAIL).sum())
+        return time.monotonic() - t0, device_s, fails, host_rows
 
     # the tunnel's bandwidth swings ~3x run to run (shared link); two
     # runs with the best reported (and both recorded) measures the
     # pipeline rather than one draw of link weather
     runs = [one_scan(), one_scan()]
-    dt, fails, host_rows = min(runs)
+    dt, device_s, fails, host_rows = min(runs)
     return {
         "resources": total,
         "chunk": chunk,
         "rules": n_rules,
+        "policies_scanned": len(policies),
+        "policies_filtered_background_false": len(all_policies) - len(policies),
         "scan_s": round(dt, 2),
+        "device_scan_s": round(device_s, 2),
         "scan_s_runs": [round(r[0], 2) for r in runs],
         "e2e_rate": round(total * n_rules / dt),
+        "device_rate": round(total * n_rules / device_s),
         "fail_cells": fails,
-        "host_rows": host_rows,
+        "host_rows_resolved": host_rows,
     }
 
 

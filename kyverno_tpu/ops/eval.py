@@ -913,9 +913,14 @@ def build_scan_fn_blob(tensors: PolicyTensors):
     def scan_blob(blob, B, P, E, V):
         parts = _split_blob(blob, B, P, E, V)
         v = base(*unpack_batch(*parts, xp=jnp))
-        fails = (v == V_FAIL).sum(axis=0, dtype=jnp.int32)
-        passes = (v == V_PASS).sum(axis=0, dtype=jnp.int32)
         host_rows = (v == V_HOST).any(axis=1)
+        # counts cover NON-host rows only: a flagged row resolves through
+        # the CPU oracle wholesale (scan callers add its counts from the
+        # oracle verdicts), so splitting by row keeps the accounting
+        # exact without reading back per-cell HOST masks
+        live = ~host_rows[:, None]
+        fails = ((v == V_FAIL) & live).sum(axis=0, dtype=jnp.int32)
+        passes = ((v == V_PASS) & live).sum(axis=0, dtype=jnp.int32)
         return fails, passes, host_rows
 
     return scan_blob
