@@ -58,7 +58,8 @@ class Verifier:
         raise VerificationError("no image verifier configured")
 
     def fetch_attestations(self, image: str, key: str = "",
-                           repository: str = "") -> list[dict]:
+                           repository: str = "", roots: str = "",
+                           subject: str = "") -> list[dict]:
         raise VerificationError("no image verifier configured")
 
 
@@ -95,7 +96,8 @@ class StaticVerifier(Verifier):
         return entry.digest
 
     def fetch_attestations(self, image: str, key: str = "",
-                           repository: str = "") -> list[dict]:
+                           repository: str = "", roots: str = "",
+                           subject: str = "") -> list[dict]:
         if image not in self.statements:
             raise VerificationError(f"no attestations found for {image}")
         return list(self.statements[image])
@@ -238,7 +240,8 @@ def _attest_image(policy_ctx, rule, spec, info, attestations,
     image = image_string(info)
     try:
         statements = verifier.fetch_attestations(
-            image, key=spec["key"], repository=spec["repository"])
+            image, key=spec["key"], repository=spec["repository"],
+            roots=spec["roots"], subject=spec["subject"])
     except VerificationError as e:
         return _rule_response(
             rule, f"failed to fetch attestations for {image}: {e}",

@@ -237,15 +237,24 @@ class RegistryVerifier(Verifier):
 
     def verify_signature(self, image: str, key: str = "", repository: str = "",
                          roots: str = "", subject: str = "") -> str:
-        if roots or subject:
-            raise VerificationError(
-                "cert-chain/keyless verification is not supported by the "
-                "registry verifier; provide a public key")
-        cache_key = ("sig", image, key, repository)
+        """Key-based OR cert-chain ("keyless") verification, mirroring
+        the reference's branch (pkg/cosign/cosign.go:80-89: a key uses
+        it directly; otherwise Roots become the trust pool and Subject
+        the certificate identity check, pkg/engine/imageVerify.go:176).
+        A policy must supply one of the two — the hosted Fulcio root
+        cosign would default to is not reachable from this engine."""
+        cache_key = ("sig", image, key, repository, roots, subject)
         hit = self._cached(cache_key)
         if hit is not None:
             return hit
-        pub = self._load_key(key)
+        if key:
+            check_layer = self._key_checker(key)
+        elif roots:
+            check_layer = self._cert_chain_checker(roots, subject)
+        else:
+            raise VerificationError(
+                "either a public key or trust roots are required "
+                "(hosted-Fulcio keyless needs a Fulcio deployment)")
         registry, repo, digest = self._resolve(image)
         sig_reg, sig_repo, sig_tag = self._cosign_ref(
             registry, repo, digest, "sig", repository)
@@ -265,8 +274,9 @@ class RegistryVerifier(Verifier):
             except (VerificationError, ValueError) as e:
                 errors.append(str(e))
                 continue
-            if not ecdsa.verify(pub, payload, sig):
-                errors.append("signature does not match key")
+            err = check_layer(layer, payload, sig)
+            if err:
+                errors.append(err)
                 continue
             # the payload must bind the digest we resolved (cosign.go:77)
             try:
@@ -283,13 +293,71 @@ class RegistryVerifier(Verifier):
         raise VerificationError(
             f"no valid signature for {image}: {'; '.join(errors) or 'none'}")
 
+    def _key_checker(self, key: str):
+        """Layer check for the bare-public-key path (ECDSA P-256)."""
+        pub = self._load_key(key)
+
+        def check(layer, payload: bytes, sig: bytes):
+            if not ecdsa.verify(pub, payload, sig):
+                return "signature does not match key"
+            return None
+
+        return check
+
+    def _cert_chain_checker(self, roots: str, subject: str):
+        """Layer check for the cert-chain path: the signature layer's
+        certificate chains to the supplied roots, its identity matches
+        ``subject`` (when set), and its public key verifies the payload
+        (engine/certchain.py; cosign keyless minus the tlog)."""
+        from . import certchain
+
+        try:
+            root_certs = certchain.load_pem_certs(roots)
+        except certchain.CertChainError as e:
+            raise VerificationError(f"invalid roots: {e}") from e
+
+        def check(layer, payload: bytes, sig: bytes):
+            ann = layer.get("annotations") or {}
+            cert_pem = ann.get(certchain.CERT_ANNOTATION, "")
+            if not cert_pem:
+                return "signature layer carries no certificate"
+            try:
+                leaf = certchain.load_pem_certs(cert_pem)[0]
+                chain = (certchain.load_pem_certs(
+                    ann[certchain.CHAIN_ANNOTATION])
+                    if ann.get(certchain.CHAIN_ANNOTATION) else [])
+                certchain.verify_chain(leaf, chain, root_certs)
+            except certchain.CertChainError as e:
+                return str(e)
+            if subject and not certchain.subject_matches(leaf, subject):
+                return (f"certificate identity "
+                        f"{certchain.cert_subjects(leaf)} does not match "
+                        f"subject {subject!r}")
+            if not certchain.verify_payload_signature(leaf, payload, sig):
+                return "signature does not match certificate key"
+            return None
+
+        return check
+
     def fetch_attestations(self, image: str, key: str = "",
-                           repository: str = "") -> list[dict]:
-        cache_key = ("att", image, key, repository)
+                           repository: str = "", roots: str = "",
+                           subject: str = "") -> list[dict]:
+        """DSSE attestation statements, verified with a public key or —
+        keyless — with the certificate on each attestation layer (chain
+        to ``roots`` + ``subject`` identity), mirroring
+        verify_signature's branch."""
+        cache_key = ("att", image, key, repository, roots, subject)
         hit = self._cached(cache_key)
         if hit is not None:
             return list(hit)
-        pub = self._load_key(key)
+        if key:
+            check_layer = self._key_checker(key)
+        elif roots:
+            check_layer = self._cert_chain_checker(roots, subject)
+        else:
+            raise VerificationError(
+                "either a public key or trust roots are required "
+                "(hosted-Fulcio keyless needs a Fulcio deployment)")
         registry, repo, digest = self._resolve(image)
         att_reg, att_repo, att_tag = self._cosign_ref(
             registry, repo, digest, "att", repository)
@@ -310,9 +378,11 @@ class RegistryVerifier(Verifier):
             except (ValueError, TypeError) as e:
                 raise VerificationError(
                     f"malformed attestation envelope: {e}") from e
-            if not any(ecdsa.verify(pub, pae, s) for s in sigs):
+            errs = [check_layer(layer, pae, s) for s in sigs]
+            if not any(e is None for e in errs):
                 raise VerificationError(
-                    f"attestation signature verification failed for {image}")
+                    "attestation signature verification failed for "
+                    f"{image}: {'; '.join(e for e in errs if e) or 'no signatures'}")
             try:
                 statement = json.loads(payload)
             except ValueError as e:

@@ -423,3 +423,357 @@ class TestWebhookE2E:
             assert "pass" in statuses and "fail" in statuses
         finally:
             controller.stop()
+
+
+# ----------------------------------------------------- cert-chain path
+
+def _ca_chain(leaf_san="dev@example.com", leaf_days=365):
+    """root CA -> intermediate CA -> leaf (all ECDSA P-256), the Fulcio
+    shape cosign attaches to keyless signatures."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    def build(cn, issuer_name, issuer_key, pub, ca, san=None, days=365):
+        b = (x509.CertificateBuilder()
+             .subject_name(name(cn))
+             .issuer_name(issuer_name)
+             .public_key(pub)
+             .serial_number(x509.random_serial_number())
+             .not_valid_before(now - datetime.timedelta(days=2))
+             .not_valid_after(now + datetime.timedelta(days=days))
+             .add_extension(
+                 x509.BasicConstraints(ca=ca, path_length=None),
+                 critical=True))
+        if san:
+            b = b.add_extension(
+                x509.SubjectAlternativeName([x509.RFC822Name(san)]),
+                critical=False)
+        return b.sign(issuer_key, hashes.SHA256())
+
+    root_key = ec.generate_private_key(ec.SECP256R1())
+    root = build("test-root", name("test-root"), root_key,
+                 root_key.public_key(), ca=True)
+    int_key = ec.generate_private_key(ec.SECP256R1())
+    inter = build("test-int", root.subject, root_key,
+                  int_key.public_key(), ca=True)
+    leaf_key = ec.generate_private_key(ec.SECP256R1())
+    leaf = build("signer", inter.subject, int_key, leaf_key.public_key(),
+                 ca=False, san=leaf_san, days=leaf_days)
+    return root, inter, leaf, leaf_key
+
+
+def _pem(*certs) -> str:
+    from cryptography.hazmat.primitives import serialization
+
+    return "".join(
+        c.public_bytes(serialization.Encoding.PEM).decode() for c in certs)
+
+
+def _cosign_sign_cert(stub, repo, digest, leaf_key, leaf, chain,
+                      bind_digest=None):
+    """Publish a keyless-style signature: cert + chain annotations."""
+    from cryptography.hazmat.primitives import hashes as _h
+    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+
+    from kyverno_tpu.engine.certchain import CERT_ANNOTATION, CHAIN_ANNOTATION
+
+    payload = json.dumps({
+        "critical": {
+            "identity": {"docker-reference": repo},
+            "image": {"docker-manifest-digest": bind_digest or digest},
+            "type": "cosign container image signature"},
+        "optional": None,
+    }).encode()
+    sig = base64.b64encode(
+        leaf_key.sign(payload, _ec.ECDSA(_h.SHA256()))).decode()
+    blob_digest = stub.put_blob(repo, payload)
+    tag = digest.replace("sha256:", "sha256-") + ".sig"
+    stub.put_manifest(repo, tag, {
+        "schemaVersion": 2,
+        "layers": [{"digest": blob_digest, "size": len(payload),
+                    "annotations": {SIG_ANNOTATION: sig,
+                                    CERT_ANNOTATION: _pem(leaf),
+                                    CHAIN_ANNOTATION: _pem(*chain)
+                                    if isinstance(chain, (list, tuple))
+                                    else _pem(chain)}}]})
+
+
+class TestCertChainVerification:
+    def _verifier(self, host):
+        return RegistryVerifier(RegistryClient(plain_http=True),
+                                default_registry=host)
+
+    def test_cert_chain_signed_image_verifies(self, stub):
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+        out = self._verifier(host).verify_signature(
+            "team/app:v1", roots=_pem(root), subject="dev@example.com")
+        assert out == digest
+
+    def test_subject_wildcard_matches(self, stub):
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+        out = self._verifier(host).verify_signature(
+            "team/app:v1", roots=_pem(root), subject="*@example.com")
+        assert out == digest
+
+    def test_wrong_subject_rejected(self, stub):
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+        with pytest.raises(VerificationError, match="does not match subject"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="ops@example.com")
+
+    def test_untrusted_root_rejected(self, stub):
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        other_root, *_ = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+        with pytest.raises(VerificationError,
+                           match="does not terminate at a trusted root"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(other_root),
+                subject="dev@example.com")
+
+    def test_expired_leaf_rejected(self, stub):
+        s, host = stub
+        # leaf validity window fully in the past
+        root, inter, leaf, leaf_key = _ca_chain(leaf_days=-1)
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+        with pytest.raises(VerificationError, match="validity window"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="dev@example.com")
+
+    def test_wrong_key_signature_rejected(self, stub):
+        # the chain is valid but the payload was signed by ANOTHER key
+        from cryptography.hazmat.primitives.asymmetric import ec as _ec
+
+        s, host = stub
+        root, inter, leaf, _ = _ca_chain()
+        rogue = _ec.generate_private_key(_ec.SECP256R1())
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, rogue, leaf, inter)
+        with pytest.raises(VerificationError,
+                           match="does not match certificate key"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="dev@example.com")
+
+    def test_no_cert_on_layer_rejected(self, stub, keypair):
+        # a plain key-signed layer offers no certificate for the chain path
+        s, host = stub
+        priv, _ = keypair
+        root, *_ = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        s.cosign_sign("team/app", digest, priv)
+        with pytest.raises(VerificationError, match="no certificate"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="dev@example.com")
+
+    def test_neither_key_nor_roots_rejected(self, stub):
+        s, host = stub
+        s.push_image("team/app", "v1")
+        with pytest.raises(VerificationError, match="public key or trust"):
+            self._verifier(host).verify_signature("team/app:v1")
+
+    def test_tampered_payload_digest_binding(self, stub):
+        # valid chain + valid signature over a payload binding a DIFFERENT
+        # digest: must be rejected (replay of another image's signature)
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter,
+                          bind_digest="sha256:" + "0" * 64)
+        with pytest.raises(VerificationError, match="binds"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="dev@example.com")
+
+
+class TestWebhookE2ECertChain:
+    """Policy-level keyless shape: verifyImages with roots/subject instead
+    of a key, through the production controller HTTP path."""
+
+    def test_roots_policy_verifies_and_wrong_subject_blocks(self, stub):
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.server import Controller
+
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, leaf_key, leaf, inter)
+
+        def policy(subject):
+            return {
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": "verify-keyless"},
+                "spec": {
+                    "validationFailureAction": "enforce",
+                    "rules": [{
+                        "name": "check-cert",
+                        "match": {"resources": {"kinds": ["Pod"]}},
+                        "verifyImages": [{
+                            "image": f"{host}/team/*",
+                            "roots": _pem(root),
+                            "subject": subject,
+                        }],
+                    }],
+                },
+            }
+
+        def run(subject):
+            cluster = FakeCluster([policy(subject)])
+            controller = Controller(
+                client=cluster, serve_port=0,
+                image_verifier=RegistryVerifier(
+                    RegistryClient(plain_http=True), default_registry=host))
+            controller.start(host="127.0.0.1")
+            try:
+                port = controller._httpd.server_address[1]
+                review = {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u1", "kind": {"kind": "Pod"},
+                                "namespace": "default",
+                                "operation": "CREATE",
+                                "object": {
+                                    "apiVersion": "v1", "kind": "Pod",
+                                    "metadata": {"name": "p",
+                                                 "namespace": "default"},
+                                    "spec": {"containers": [{
+                                        "name": "c",
+                                        "image": f"{host}/team/app:v1"}]}}}}
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/mutate",
+                    data=json.dumps(review).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+            finally:
+                controller.stop()
+
+        good = run("dev@example.com")
+        assert good["response"]["allowed"] is True
+        patch = json.loads(base64.b64decode(good["response"]["patch"]))
+        assert any(p["value"].endswith("@" + digest) for p in patch)
+
+        bad = run("ops@example.com")
+        assert bad["response"]["allowed"] is False
+        assert "image verification failed" in \
+            bad["response"]["status"]["message"]
+
+
+class TestCertChainHardening:
+    """The trust model's sharp edges: a non-CA cert must never act as an
+    issuer, and an unvalidated CN must never satisfy the subject check
+    when SANs exist."""
+
+    def _verifier(self, host):
+        return RegistryVerifier(RegistryClient(plain_http=True),
+                                default_registry=host)
+
+    def test_leaf_cannot_mint_identities(self, stub):
+        # attacker holds a legitimate NON-CA leaf under the trusted root
+        # and uses its key to issue a rogue cert claiming dev@example.com
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+
+        s, host = stub
+        root, inter, atk_leaf, atk_key = _ca_chain(
+            leaf_san="attacker@example.com")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        rogue_key = ec.generate_private_key(ec.SECP256R1())
+        rogue = (x509.CertificateBuilder()
+                 .subject_name(x509.Name([x509.NameAttribute(
+                     NameOID.COMMON_NAME, "rogue")]))
+                 .issuer_name(atk_leaf.subject)
+                 .public_key(rogue_key.public_key())
+                 .serial_number(x509.random_serial_number())
+                 .not_valid_before(now - datetime.timedelta(days=1))
+                 .not_valid_after(now + datetime.timedelta(days=30))
+                 .add_extension(x509.SubjectAlternativeName(
+                     [x509.RFC822Name("dev@example.com")]), critical=False)
+                 .sign(atk_key, hashes.SHA256()))
+        digest = s.push_image("team/app", "v1")
+        _cosign_sign_cert(s, "team/app", digest, rogue_key, rogue,
+                          [atk_leaf, inter])
+        with pytest.raises(VerificationError,
+                           match="does not terminate at a trusted root"):
+            self._verifier(host).verify_signature(
+                "team/app:v1", roots=_pem(root), subject="dev@example.com")
+
+    def test_cn_never_matches_when_sans_present(self, stub):
+        # cert with SAN attacker@evil.io but CN dev@example.com: the CN
+        # is unvalidated by CAs and must not satisfy the subject check
+        from kyverno_tpu.engine import certchain
+
+        _, _, leaf, _ = _ca_chain(leaf_san="attacker@evil.io")
+        # the builder sets CN "signer"; assert SAN-present semantics via
+        # cert_subjects directly (CN excluded when SANs exist)
+        assert certchain.cert_subjects(leaf) == ["attacker@evil.io"]
+        assert not certchain.subject_matches(leaf, "signer")
+
+
+class TestKeylessAttestations:
+    def test_cert_chain_attestation_verifies(self, stub):
+        from cryptography.hazmat.primitives import hashes as _h
+        from cryptography.hazmat.primitives.asymmetric import ec as _ec
+
+        from kyverno_tpu.engine.certchain import (
+            CERT_ANNOTATION,
+            CHAIN_ANNOTATION,
+        )
+
+        s, host = stub
+        root, inter, leaf, leaf_key = _ca_chain()
+        digest = s.push_image("team/app", "v1")
+        statement = {"predicateType": "https://slsa.dev/provenance/v1",
+                     "predicate": {"builder": {"id": "ci"}},
+                     "subject": [{"name": "team/app",
+                                  "digest": {"sha256":
+                                             digest.split(":", 1)[-1]}}]}
+        payload = json.dumps(statement).encode()
+        ptype = "application/vnd.in-toto+json"
+        sig = base64.b64encode(leaf_key.sign(
+            dsse_pae(ptype, payload), _ec.ECDSA(_h.SHA256()))).decode()
+        envelope = json.dumps({
+            "payloadType": ptype,
+            "payload": base64.b64encode(payload).decode(),
+            "signatures": [{"sig": sig}],
+        }).encode()
+        blob_digest = s.put_blob("team/app", envelope)
+        tag = digest.replace("sha256:", "sha256-") + ".att"
+        s.put_manifest("team/app", tag, {
+            "schemaVersion": 2,
+            "layers": [{"digest": blob_digest, "size": len(envelope),
+                        "annotations": {CERT_ANNOTATION: _pem(leaf),
+                                        CHAIN_ANNOTATION: _pem(inter)}}]})
+        v = RegistryVerifier(RegistryClient(plain_http=True),
+                             default_registry=host)
+        out = v.fetch_attestations("team/app:v1", roots=_pem(root),
+                                   subject="dev@example.com")
+        assert out and out[0]["predicateType"].startswith("https://slsa")
+        # wrong subject: rejected
+        with pytest.raises(VerificationError):
+            RegistryVerifier(RegistryClient(plain_http=True),
+                             default_registry=host).fetch_attestations(
+                "team/app:v1", roots=_pem(root), subject="ops@example.com")
