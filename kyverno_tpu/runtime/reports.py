@@ -73,10 +73,31 @@ class ReportGenerator:
     ClusterPolicyReport. ``reconcile`` rebuilds from scratch (the full
     reconcile channel of cmd/kyverno/main.go:260)."""
 
-    def __init__(self, client=None):
+    def __init__(self, client=None, persist_requests: bool | None = None):
         self.client = client
+        # CR-backed request transport (reportrequest.go +
+        # changerequestcreator.go): every replica persists its change
+        # requests as ReportChangeRequest/ClusterReportChangeRequest CRs,
+        # and the leader's aggregate() consumes-and-deletes them
+        # (reportcontroller.go:501,682). Default ON whenever a cluster
+        # client exists — an in-process pending list cannot carry a
+        # non-leader replica's audit/scan results to the leader. Without
+        # a client the in-process list remains (CLI, tests).
+        self.persist_requests = (client is not None
+                                 if persist_requests is None
+                                 else persist_requests)
         self._lock = threading.Lock()
         self._pending: list[dict] = []
+        # async CR writer (changerequestcreator.go's queued creator): the
+        # admission path must never block on report persistence — an
+        # enqueue costs a deque append; the writer thread owns the API
+        # round trips and retries transient failures
+        from collections import deque
+
+        self._queue: deque = deque()
+        self._writer_wake = threading.Event()
+        self._writer_stop = threading.Event()
+        self._writer: threading.Thread | None = None
         # current-state result store: (ns, policy, rule, kind, name) -> result.
         # Reports are REBUILT from this map each aggregate() — stored report
         # objects are replaced, never merged, so deleted policies/resources
@@ -87,15 +108,85 @@ class ReportGenerator:
         self._known_ns: set[str] = set()
 
     def add(self, *responses: EngineResponse) -> None:
-        with self._lock:
-            for resp in responses:
-                rcr = build_change_request(resp)
-                if rcr is not None:
-                    self._pending.append(rcr)
+        for resp in responses:
+            rcr = build_change_request(resp)
+            if rcr is not None:
+                self.add_change_request(rcr)
 
     def add_change_request(self, rcr: dict) -> None:
+        if self.client is not None and self.persist_requests:
+            self._queue.append(rcr)
+            self._ensure_writer()
+            self._writer_wake.set()
+            return
         with self._lock:
             self._pending.append(rcr)
+
+    # --------------------------------------------------- async CR writer
+
+    def _ensure_writer(self) -> None:
+        if self._writer is not None and self._writer.is_alive():
+            return
+        with self._lock:
+            if self._writer is not None and self._writer.is_alive():
+                return
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="rcr-writer", daemon=True)
+            self._writer.start()
+
+    def _writer_loop(self) -> None:
+        while not self._writer_stop.is_set():
+            self._writer_wake.wait(1.0)
+            self._writer_wake.clear()
+            self._drain_queue()
+
+    def _drain_queue(self) -> None:
+        while self._queue:
+            try:
+                rcr = self._queue.popleft()
+            except IndexError:
+                return
+            for attempt in (0, 1):
+                try:
+                    self._write_rcr(rcr)
+                    break
+                except Exception:
+                    # first failure may be a racing delete/conflict — the
+                    # retry re-gets; a second failure re-queues with a
+                    # breather so the result is never dropped
+                    if attempt == 1:
+                        self._queue.append(rcr)
+                        self._writer_stop.wait(0.5)
+                        return
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until every queued change request is persisted (tests,
+        shutdown). True when the queue drained."""
+        deadline = time.monotonic() + timeout_s
+        while self._queue and time.monotonic() < deadline:
+            self._writer_wake.set()
+            time.sleep(0.01)
+        return not self._queue
+
+    def stop(self) -> None:
+        self._writer_stop.set()
+        self._writer_wake.set()
+        if self._writer is not None:
+            self._writer.join(timeout=2.0)
+
+    def _write_rcr(self, rcr: dict) -> None:
+        """Create-or-replace the change request CR by its deterministic
+        name — the latest result for a (policy, resource) pair wins, the
+        changerequestcreator.go dedup."""
+        meta = rcr.get("metadata") or {}
+        existing = self.client.get_resource(
+            rcr["apiVersion"], rcr["kind"],
+            meta.get("namespace", ""), meta.get("name", ""))
+        if existing is None:
+            self.client.create_resource(rcr)
+        else:
+            existing["results"] = rcr["results"]
+            self.client.update_resource(existing)
 
     def prune_policy(self, policy_name: str) -> None:
         """Drop all results of a deleted policy (policy delete handler in
@@ -122,7 +213,32 @@ class ReportGenerator:
     def aggregate(self) -> list[dict]:
         """reportcontroller.go:501 aggregateReports + :541 mergeRequests:
         consume pending requests into the result store, emit report objects
-        rebuilt from the store."""
+        rebuilt from the store. With a cluster client, change-request CRs
+        written by EVERY replica are consumed and deleted here — the
+        leader-side half of the CR transport (reportcontroller.go:682
+        cleanup of consumed requests)."""
+        consumed: list[tuple] = []
+        if self.client is not None and self.persist_requests:
+            # the leader's OWN queued requests consume directly — writing
+            # them out only to immediately read them back buys nothing
+            while self._queue:
+                try:
+                    with self._lock:
+                        self._pending.append(self._queue.popleft())
+                except IndexError:
+                    break
+            for kind in ("ReportChangeRequest", "ClusterReportChangeRequest"):
+                try:
+                    items = list(self.client.list_resource(
+                        "kyverno.io/v1alpha2", kind))
+                except Exception:
+                    items = []
+                for rcr in items:
+                    meta = rcr.get("metadata") or {}
+                    with self._lock:
+                        self._pending.append(rcr)
+                    consumed.append((kind, meta.get("namespace", ""),
+                                     meta.get("name", "")))
         with self._lock:
             pending = self._pending
             self._pending = []
@@ -172,4 +288,14 @@ class ReportGenerator:
                     existing["results"] = report["results"]
                     existing["summary"] = report["summary"]
                     self.client.update_resource(existing)
+            # delete consumed change requests ONLY after the merged
+            # reports are durably written: a crash between consumption
+            # and the write must leave the CRs for the next leader
+            # (reportcontroller.go:682 cleanup ordering)
+            for kind, ns, name in consumed:
+                try:
+                    self.client.delete_resource(
+                        "kyverno.io/v1alpha2", kind, ns, name)
+                except Exception:
+                    pass
         return reports

@@ -288,6 +288,11 @@ class Controller:
             self.admission_batcher.stop()
         self.webhook.stop()
         self.event_gen.stop()
+        # persist any still-queued report change requests, then stop the
+        # writer — results produced just before shutdown must reach the
+        # cluster for the next leader to aggregate
+        self.report_gen.flush(timeout_s=2.0)
+        self.report_gen.stop()
         self.generate_controller.stop()
         self.crd_sync.stop()
         self.monitor.stop()

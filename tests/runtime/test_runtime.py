@@ -339,3 +339,67 @@ class TestGenerateController:
         )
         grs = cluster.list_resource("kyverno.io/v1", "GenerateRequest")
         assert grs[0]["status"]["state"] == GR_COMPLETED
+
+
+class TestMultiReplicaReportFanIn:
+    def test_two_replicas_merge_into_one_report(self):
+        """The round-5 'done' shape (reportrequest.go CR transport): two
+        webhook replicas over ONE cluster persist their audit results as
+        ReportChangeRequest CRs; the leader replica's aggregate()
+        consumes them into a single merged PolicyReport and deletes the
+        consumed requests."""
+        from kyverno_tpu.runtime.client import FakeCluster
+
+        cluster = FakeCluster()
+        audit_doc = json.loads(json.dumps(ENFORCE_POLICY))
+        audit_doc["spec"]["validationFailureAction"] = "audit"
+
+        def replica():
+            cache = PolicyCache()
+            cache.add(load_policy(audit_doc))
+            reports = ReportGenerator(client=cluster)
+            server = WebhookServer(policy_cache=cache, client=cluster,
+                                   report_gen=reports)
+            server.audit_handler.run()
+            return server, reports
+
+        r1, leader_reports = replica()
+        r2, _follower_reports = replica()
+        try:
+            # different resources admit through DIFFERENT replicas
+            r1.handle(VALIDATING_WEBHOOK_PATH, review(pod(name="from-r1")))
+            r2.handle(VALIDATING_WEBHOOK_PATH, review(pod(name="from-r2")))
+            r1.audit_handler.drain()
+            r2.audit_handler.drain()
+            # persistence is async (the admission path never blocks on
+            # the API): wait for both replicas' writers
+            assert leader_reports.flush()
+            assert _follower_reports.flush()
+
+            # both replicas' results exist as RCR CRs on the cluster
+            rcrs = cluster.list_resource("kyverno.io/v1alpha2",
+                                         "ReportChangeRequest")
+            names = {((r.get("results") or [{}])[0].get("resources")
+                      or [{}])[0].get("name") for r in rcrs}
+            assert names == {"from-r1", "from-r2"}
+
+            # ONLY the leader aggregates: its report carries both rows
+            built = leader_reports.aggregate()
+            polr = [b for b in built if b["kind"] == "PolicyReport"]
+            assert len(polr) == 1
+            rows = {((r.get("resources") or [{}])[0].get("name"))
+                    for r in polr[0]["results"]}
+            assert rows == {"from-r1", "from-r2"}
+            assert polr[0]["summary"]["fail"] == 2
+
+            # consumed requests are deleted (reportcontroller.go:682)
+            assert cluster.list_resource("kyverno.io/v1alpha2",
+                                         "ReportChangeRequest") == []
+            # and the merged PolicyReport was written to the cluster
+            stored = cluster.get_resource("wgpolicyk8s.io/v1alpha2",
+                                          "PolicyReport", "default",
+                                          "polr-ns-default")
+            assert stored is not None and len(stored["results"]) == 2
+        finally:
+            r1.audit_handler.stop()
+            r2.audit_handler.stop()
