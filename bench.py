@@ -289,6 +289,52 @@ def bench_config1(jax):
         nc_server.stop()
         nc_batcher.stop()
 
+    # audit burst: the same 250-policy library in audit mode, drained
+    # through the queue (validate_audit.go's 10 workers). Audit has no
+    # latency budget, so the screen engages deadline-free and identical
+    # repeats dedup via the TTL memo (ResourceManager analogue,
+    # pkg/policy/existing.go:125). The oracle-only figure processes the
+    # same queue with the screen disabled.
+    audit_lib = _library_250()
+    for p in audit_lib:
+        p.spec.validation_failure_action = "audit"
+
+    def drain_audit(with_screen: bool, n: int = 256) -> float:
+        cache = PolicyCache()
+        for p in audit_lib:
+            cache.add(p)
+        batcher = AdmissionBatcher(cache) if with_screen else None
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        if with_screen:
+            batcher.warmup(PolicyType.VALIDATE_AUDIT, "Pod", "default",
+                           make_pod(1))
+        req_obj = {"uid": "a", "kind": {"kind": "Pod"},
+                   "namespace": "default", "operation": "CREATE",
+                   "object": make_pod(1)}
+        server.audit_handler.run()
+        try:
+            server._process_audit(dict(req_obj))    # warm both lanes
+            t0 = time.monotonic()
+            for _ in range(n):
+                server.audit_handler.add(dict(req_obj))
+            server.audit_handler.drain(timeout=600)
+            return time.monotonic() - t0
+        finally:
+            server.audit_handler.stop()
+            if batcher is not None:
+                batcher.stop()
+
+    audit_n = 256
+    screened_s = drain_audit(True, audit_n)
+    oracle_s = drain_audit(False, audit_n)
+    audit_burst = {
+        "n": audit_n, "policies": len(audit_lib),
+        "screened_req_per_s": round(audit_n / screened_s),
+        "oracle_req_per_s": round(audit_n / oracle_s),
+        "speedup": round(oracle_s / screened_s, 1),
+    }
+
     return {
         "latency_ms_p50": p50,
         "latency_ms_p99": p99,
@@ -310,6 +356,7 @@ def bench_config1(jax):
             "latency_ms_p50": ncp50, "latency_ms_p99": ncp99,
             "req_per_s": nc_rps,
             "routing": routing_nc},
+        "audit_burst_library_250": audit_burst,
         "path": "HTTP POST /validate (production handler, latency-routed)",
     }
 

@@ -51,6 +51,20 @@ WEBHOOK_TIMEOUT_S = 10.0
 SCREEN_DEADLINE_S = WEBHOOK_TIMEOUT_S / 4
 
 
+def ttl_store(cache: dict, key, ttl_s: float, value: tuple,
+              max_size: int = 4096) -> None:
+    """Insert ``(expiry, *value)`` with the shared eviction policy:
+    sweep expired entries when full, clear wholesale if still full.
+    The caller holds whatever lock guards ``cache``."""
+    if len(cache) >= max_size:
+        cutoff = time.monotonic()
+        for k in [k for k, v in cache.items() if v[0] <= cutoff]:
+            del cache[k]
+        if len(cache) >= max_size:
+            cache.clear()
+    cache[key] = (time.monotonic() + ttl_s, *value)
+
+
 def verdict_to_status(verdict: Verdict):
     """Device verdict -> RuleStatus (None for non-statuses like HOST)."""
     from ..engine.response import RuleStatus
@@ -216,7 +230,8 @@ class AdmissionBatcher:
             frac = max(0.0, 1.0 - seconds / full) if full > 0 else 0.0
             self._savings_frac += 0.3 * (frac - self._savings_frac)
 
-    def _device_favored(self, est_batch: int, n_policies: int) -> bool:
+    def _device_favored(self, est_batch: int, n_policies: int,
+                        deadline_free: bool = False) -> bool:
         # amortize over the batch size dispatches actually realize, not
         # the instantaneous concurrency (the window only captures what
         # arrives within it); allow 2x headroom so the lane can bootstrap
@@ -233,7 +248,11 @@ class AdmissionBatcher:
         cpu_won = oracle_drain * self._savings_frac > self._flush_cpu_cost
         # latency: the device answer (behind any flushes already in
         # flight) must beat the oracle queue's drain time, and fit the
-        # deadline budget
+        # deadline budget. Deadline-free callers (the audit queue — no
+        # one is waiting on an admission response) skip this gate: for
+        # them the device wins whenever it saves CPU, period.
+        if deadline_free:
+            return cpu_won
         device_latency = (self._dispatch_cost * (1 + self._pending_flushes)
                           + self.window_s)
         lat_ok = device_latency < min(oracle_drain, SCREEN_DEADLINE_S)
@@ -317,15 +336,8 @@ class AdmissionBatcher:
 
     def _cache_store(self, cache_key, status, row) -> None:
         """Caller holds self._lock."""
-        if len(self._result_cache) >= self.result_cache_max:
-            cutoff = time.monotonic()
-            self._result_cache = {
-                k: v for k, v in self._result_cache.items()
-                if v[0] > cutoff}
-            if len(self._result_cache) >= self.result_cache_max:
-                self._result_cache.clear()
-        self._result_cache[cache_key] = (
-            time.monotonic() + self.result_cache_ttl_s, status, row)
+        ttl_store(self._result_cache, cache_key, self.result_cache_ttl_s,
+                  (status, row), max_size=self.result_cache_max)
 
     def decision_key(self, ptype, kind: str, namespace: str, resource: dict,
                      env: dict | None = None):
@@ -357,7 +369,7 @@ class AdmissionBatcher:
 
     def screen(self, ptype, kind: str, namespace: str, resource: dict,
                timeout_s: float = SCREEN_DEADLINE_S,
-               env: dict | None = None):
+               env: dict | None = None, deadline_free: bool = False):
         """Returns (CLEAN | ATTENTION | ORACLE, [(policy, rule, Verdict), ...]).
 
         ORACLE means "the device does not pay for this request — evaluate
@@ -409,7 +421,8 @@ class AdmissionBatcher:
                 if est_batch < self.burst_threshold:
                     self.stats["oracle"] += 1
                     return ORACLE, []
-                if not self._device_favored(est_batch, len(cps.policies)):
+                if not self._device_favored(est_batch, len(cps.policies),
+                                            deadline_free):
                     # keep the dispatch-cost EMA honest without making any
                     # request wait: occasionally send a fire-and-forget
                     # shadow copy of this burst member to the device — in a
@@ -438,7 +451,7 @@ class AdmissionBatcher:
             # budget — their first flush legitimately pays XLA compilation
             adaptive = bool(self._seen_shapes.get(cps))
             deadline_budget = timeout_s
-            if adaptive:
+            if adaptive and not deadline_free:
                 timeout_s = min(timeout_s,
                                 max(0.05, 4 * self._dispatch_cost
                                     + self.window_s)

@@ -105,6 +105,9 @@ class WebhookServer:
         # (policy generation + resource + requester digest)
         self._decision_cache: dict = {}
         self._decision_lock = threading.Lock()
+        # TTL dedup of identical audit work (ResourceManager analogue,
+        # pkg/policy/existing.go:125): key -> (expiry, metric rows)
+        self._audit_memo: dict = {}
         self._httpd: ThreadingHTTPServer | None = None
 
     # ------------------------------------------------------------ dispatch
@@ -294,7 +297,7 @@ class WebhookServer:
         return _admission_response(uid, True, patches=patches)
 
     def _record_screen_results(self, row, resource: dict, kind: str,
-                               request: dict) -> list:
+                               request: dict, mode: str = "enforce") -> list:
         """Metrics + report rows for a device-screened admission, matching
         what the oracle loop records for passing resources."""
         from ..engine.response import (
@@ -316,7 +319,7 @@ class WebhookServer:
             recorded.append((policy_name, rule_name, status.value))
             metrics_mod.record_policy_results(
                 self.registry, policy_name, rule_name, status.value,
-                validation_mode="enforce", resource_kind=kind,
+                validation_mode=mode, resource_kind=kind,
                 request_operation=request.get("operation", "CREATE"))
             if self.report_gen is None and self.event_gen is None:
                 continue
@@ -330,8 +333,13 @@ class WebhookServer:
                             api_version=resource.get("apiVersion", ""),
                             namespace=meta.get("namespace", ""),
                             name=meta.get("name", ""))))
+            # a device PASS is the oracle's pattern-pass outcome: carry the
+            # same message text so screened and oracle report rows agree
+            message = (f"validation rule '{rule_name}' passed."
+                       if status is RuleStatus.PASS else "")
             resp.policy_response.rules.append(RuleResponse(
-                name=rule_name, type=RuleType.VALIDATION, status=status))
+                name=rule_name, type=RuleType.VALIDATION, status=status,
+                message=message))
         for resp in per_policy.values():
             if self.report_gen is not None:
                 self.report_gen.add(resp)
@@ -567,15 +575,8 @@ class WebhookServer:
         if ttl <= 0:
             return
         with self._decision_lock:
-            if len(self._decision_cache) >= 4096:
-                cutoff = time.monotonic()
-                self._decision_cache = {
-                    k: v for k, v in self._decision_cache.items()
-                    if v[0] > cutoff}
-                if len(self._decision_cache) >= 4096:
-                    self._decision_cache.clear()
-            self._decision_cache[decision_key] = (
-                time.monotonic() + ttl, allowed, message, metric_rows)
+            batch_mod.ttl_store(self._decision_cache, decision_key, ttl,
+                                (allowed, message, metric_rows))
 
     def _pool_oracle(self, policies, resource: dict, request: dict,
                      namespace: str):
@@ -651,17 +652,75 @@ class WebhookServer:
         return out
 
     def _process_audit(self, request: dict) -> None:
-        """validate_audit.go:151 process."""
+        """validate_audit.go:151 process — with the device screen in
+        front: queued audit work has NO latency budget, making it the
+        ideal device workload. Concurrent audit workers' screens coalesce
+        into shared flushes; policies the device clears record straight
+        from the verdict row, and only policies with a FAIL/ERROR/HOST
+        cell re-run the CPU oracle (for faithful messages and
+        context-dependent semantics) — the enforce path's hybrid merge,
+        minus any deadline pressure."""
         kind = ((request.get("kind") or {}).get("kind")) or ""
         namespace = request.get("namespace", "")
         resource = request.get("object") or {}
-        pctx = self._policy_context(request, resource)
-        for policy in self.policy_cache.get_policies(
-            PolicyType.VALIDATE_AUDIT, kind, namespace
-        ):
+        audit_policies = self.policy_cache.get_policies(
+            PolicyType.VALIDATE_AUDIT, kind, namespace)
+        if not audit_policies:
+            return
+        run_policies = audit_policies
+        memo_key = None
+        if self.admission_batcher is not None:
+            env = {"operation": request.get("operation"),
+                   "userInfo": request.get("userInfo"),
+                   "oldObject": request.get("oldObject")}
+            # TTL dedup of identical audit work — the reference's
+            # ResourceManager does exactly this for background processing
+            # (pkg/policy/existing.go:125): a repeat of an identical
+            # request re-records metrics but skips the engine; the report
+            # rows it would produce are already in the store (idempotent)
+            memo_key = self.admission_batcher.decision_key(
+                PolicyType.VALIDATE_AUDIT, kind, namespace, resource,
+                env=env)
+            hit = (self._audit_memo.get(memo_key)
+                   if memo_key is not None else None)
+            if hit is not None and hit[0] > time.monotonic():
+                for pn, rn, sv in hit[1]:
+                    metrics_mod.record_policy_results(
+                        self.registry, pn, rn, sv,
+                        validation_mode="audit", resource_kind=kind,
+                        request_operation=request.get("operation", "CREATE"))
+                return
+            # a deadline-free screen must also WAIT deadline-free: with a
+            # backed-up link, abandoning at the admission deadline would
+            # discard the in-flight device work and run the full oracle
+            # anyway — strictly worse than not screening
+            status, row = self.admission_batcher.screen(
+                PolicyType.VALIDATE_AUDIT, kind, namespace, resource,
+                env=env, deadline_free=True,
+                timeout_s=batch_mod.WEBHOOK_TIMEOUT_S * 6)
+            if status != batch_mod.ORACLE and row:
+                from ..models import Verdict
+
+                bad = {p for p, _, v in row
+                       if v not in (Verdict.PASS, Verdict.SKIP)}
+                audit_rows = self._record_screen_results(
+                    [t for t in row if t[0] not in bad],
+                    resource, kind, request, mode="audit")
+                run_policies = [p for p in audit_policies if p.name in bad]
+            else:
+                audit_rows = []
+        else:
+            audit_rows = []
+        # context build (roles, image info, ns labels) only when the
+        # oracle actually runs — the screened-clean common case skips it
+        pctx = (self._policy_context(request, resource)
+                if run_policies else None)
+        for policy in run_policies:
             pctx.policy = policy
             resp = engine_validate(pctx)
             for rule in resp.policy_response.rules:
+                audit_rows.append(
+                    (policy.name, rule.name, rule.status.value))
                 metrics_mod.record_policy_results(
                     self.registry, policy.name, rule.name, rule.status.value,
                     validation_mode="audit", resource_kind=kind,
@@ -670,6 +729,14 @@ class WebhookServer:
                 self.event_gen.add(*events_for_engine_response(resp))
             if self.report_gen is not None:
                 self.report_gen.add(resp)
+        if (memo_key is not None and self.admission_batcher is not None
+                and self.admission_batcher.result_cache_ttl_s > 0
+                and all(sv in ("pass", "fail", "skip", "error")
+                        for _, _, sv in audit_rows)):
+            with self._decision_lock:   # audit workers store concurrently
+                batch_mod.ttl_store(
+                    self._audit_memo, memo_key,
+                    self.admission_batcher.result_cache_ttl_s, (audit_rows,))
 
     def _apply_generate_policies(self, request: dict) -> None:
         """webhooks/generation.go: matching generate rules become
