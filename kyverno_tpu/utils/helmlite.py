@@ -65,10 +65,21 @@ def _parse(tokens, i=0, until=()):
             i += 1
             continue
         expr = tok[1]
+        if expr.startswith("/*"):
+            # Go-template comment: valid Helm, renders as nothing
+            i += 1
+            continue
         word = expr.split(None, 1)[0] if expr else ""
         if word in until:
             return nodes, i
         i += 1
+        if word in ("range", "with", "block", "template") or \
+                word.startswith("$"):
+            # constructs outside the supported subset must fail LOUDLY:
+            # rendering `range` as literal text would produce a manifest
+            # that LOOKS valid while helm template disagrees (the drift
+            # the golden-render test exists to catch)
+            raise ValueError(f"unsupported template construct: {expr!r}")
         if word == "if":
             then, i = _parse(tokens, i, until=("else", "end"))
             els = []
@@ -208,10 +219,19 @@ class Renderer:
             return head.strip('"')
         if head.startswith("."):
             return _lookup(head, self.ctx)
+        if head == "true":
+            return True
+        if head == "false":
+            return False
+        if head == "nil":
+            return None
         try:
             return int(head)
         except ValueError:
-            return head
+            pass
+        # bare words are not values in Go templates — an unknown function
+        # or sprig call here must fail loudly, never render as its own name
+        raise ValueError(f"unsupported template expression: {expr!r}")
 
     def _apply(self, stage: str, val):
         args = _split_args(stage)

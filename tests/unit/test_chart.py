@@ -151,3 +151,53 @@ class TestGitTestSources:
         assert "1/1 passed" in out.out      # local corpus ran and passed
         assert "failed to clone" in out.err
         assert rc == 1                      # but the run still fails
+
+
+class TestRenderDriftGuard:
+    def test_default_render_matches_vendored_golden(self):
+        """The offline renderer implements a hand-rolled Go-template
+        subset; a chart edit that renders differently (or wrongly) must
+        fail THIS diff, not ship silently. Regenerating the golden is a
+        deliberate act recorded in its header."""
+        import yaml
+
+        got = render_chart(CHART)
+        golden = REPO / "deploy" / "chart" / "golden-default-render.yaml"
+        with open(golden) as f:
+            want = [d for d in yaml.safe_load_all(f) if d]
+        assert got == want
+
+    def test_unsupported_constructs_fail_loudly(self, tmp_path):
+        """range/with/$vars/unknown functions raise instead of rendering
+        as literal text that LOOKS like a valid manifest."""
+        import pytest
+
+        def chart_with(body: str):
+            d = tmp_path / "c"
+            (d / "templates").mkdir(parents=True, exist_ok=True)
+            (d / "Chart.yaml").write_text(
+                "name: t\nversion: 0.1.0\nappVersion: '1'\n")
+            (d / "values.yaml").write_text("items: [a, b]\n")
+            (d / "templates" / "x.yaml").write_text(body)
+            return d
+
+        for body in (
+            "data:\n{{ range .Values.items }}\n- {{ . }}\n{{ end }}\n",
+            "x: {{ with .Values.items }}y{{ end }}\n",
+            "x: {{ $v := .Values.items }}\n",
+            "x: {{ printf \"%s\" .Values.items }}\n",
+            "x: {{ .Values.items | upper }}\n",
+        ):
+            with pytest.raises(ValueError, match="unsupported template"):
+                render_chart(chart_with(body))
+
+    def test_template_comments_render_as_nothing(self, tmp_path):
+        d = tmp_path / "c"
+        (d / "templates").mkdir(parents=True)
+        (d / "Chart.yaml").write_text(
+            "name: t\nversion: 0.1.0\nappVersion: '1'\n")
+        (d / "values.yaml").write_text("x: 1\n")
+        (d / "templates" / "x.yaml").write_text(
+            "{{- /* a helm comment */ -}}\nv: {{ .Values.x }}\n")
+        docs = render_chart(d)
+        assert docs == [{"v": 1}]
