@@ -298,13 +298,16 @@ def bench_config1(jax):
     audit_lib = _library_250()
     for p in audit_lib:
         p.spec.validation_failure_action = "audit"
+    # ONE policy cache for both lanes: the compiled tensors/XLA artifacts
+    # hang off it, and a fresh cache per lane would recompile on the
+    # real chip (~20-40s per shape) for no measurement value
+    audit_cache = PolicyCache()
+    for p in audit_lib:
+        audit_cache.add(p)
 
     def drain_audit(with_screen: bool, n: int = 256) -> float:
-        cache = PolicyCache()
-        for p in audit_lib:
-            cache.add(p)
-        batcher = AdmissionBatcher(cache) if with_screen else None
-        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+        batcher = AdmissionBatcher(audit_cache) if with_screen else None
+        server = WebhookServer(policy_cache=audit_cache, client=FakeCluster(),
                                admission_batcher=batcher)
         if with_screen:
             batcher.warmup(PolicyType.VALIDATE_AUDIT, "Pod", "default",
@@ -480,9 +483,15 @@ def bench_config4(jax):
     bm = BatchMutator(pols)
     bm.apply(docs[:64])   # warm
 
-    t0 = time.monotonic()
-    out = bm.apply(docs)  # auto gate: kind-only -> host comparison
-    dt = time.monotonic() - t0
+    # best-of-2: this tier is pure CPU and the sandbox host is shared,
+    # so single draws swing ~2x (same policy as config 5's runs)
+    def timed_apply(m, corpus):
+        t0 = time.monotonic()
+        result = m.apply(corpus)
+        return time.monotonic() - t0, result
+
+    dt, out = min((timed_apply(bm, docs) for _ in range(2)),
+                  key=lambda t: t[0])
 
     # byte-parity vs the serial engine chain on a 1k sample
     mismatches = 0
@@ -518,9 +527,8 @@ def bench_config4(jax):
         # device lane chosen: pre-compile every chunk-shape bucket the
         # timed run will use (8192-chunks + the tail bucket)
         bm2.gate_verdicts(mixed)
-    t0 = time.monotonic()
-    out2 = bm2.apply(mixed)
-    dt2 = time.monotonic() - t0
+    dt2, out2 = min((timed_apply(bm2, mixed) for _ in range(2)),
+                    key=lambda t: t[0])
 
     return {
         "n_docs": n,
