@@ -30,6 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compilecache import enable as _enable_compile_cache
+
+_enable_compile_cache()   # persistent XLA cache: warm restarts skip compiles
+
 from ..models.compiler import PolicyTensors
 from ..models.ir import (
     AUX_DENY,

@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache, on by default.
+
+First compilation of each kernel shape costs ~20-40s against the
+tunnel-attached chip; the policy engine's shape-bucketing keeps the
+shape count small and stable, which makes a persistent cache unusually
+effective — a controller restart (or a benchmark rerun) skips straight
+to warm dispatch (measured: 10.5s -> 0.5s across processes on the axon
+backend). The cache is content-addressed by program + compiler
+fingerprint, so a mismatched backend simply misses and recompiles.
+
+``KTPU_COMPILE_CACHE=0`` disables; ``KTPU_COMPILE_CACHE_DIR`` overrides
+the location (default: ``.jax_compilation_cache/`` at the repo root,
+gitignored).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_enabled = False
+
+
+def enable() -> None:
+    """Idempotent; called wherever jit functions are built (ops.eval
+    import). Must run before heavy compilation, not before jax import."""
+    global _enabled
+    if _enabled or os.environ.get("KTPU_COMPILE_CACHE", "1") == "0":
+        return
+    explicit = os.environ.get("KTPU_COMPILE_CACHE_DIR")
+    path = explicit or str(
+        Path(__file__).resolve().parents[2] / ".jax_compilation_cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    except Exception as e:
+        # best-effort by default — but an EXPLICIT opt-in that can't take
+        # effect must say so, or every restart silently pays full compiles
+        if explicit:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "KTPU_COMPILE_CACHE_DIR=%s set but the persistent "
+                "compilation cache could not be enabled: %s", explicit, e)
