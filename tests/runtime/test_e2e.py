@@ -55,7 +55,9 @@ class TestControllerE2E:
                     f"http://127.0.0.1:{port}{path}",
                     data=json.dumps(body).encode(),
                     headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req, timeout=5) as resp:
+                # generous timeout: the suite may share the host with
+                # other CPU-heavy work (observed flake at 5s under load)
+                with urllib.request.urlopen(req, timeout=30) as resp:
                     return json.loads(resp.read())
 
             # enforce blocks over the wire
