@@ -31,9 +31,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.compilecache import enable as _enable_compile_cache
-
-_enable_compile_cache()   # persistent XLA cache: warm restarts skip compiles
-
 from ..models.compiler import PolicyTensors
 from ..models.ir import (
     AUX_DENY,
@@ -83,6 +80,11 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
     """Close over the static policy tensors; returns a jit'd function of the
     flattened batch. Static data lands in the jaxpr as constants, so XLA
     folds the per-check dispatch into straight-line vector code."""
+
+    # every jit path (packed/blob/scan/mesh) funnels through here, and a
+    # real compile is imminent — the right moment for the persistent
+    # compilation cache (accelerator backends only)
+    _enable_compile_cache()
 
     path_len = np.array([len(p.split(SEP)) for p in tensors.paths], dtype=np.int32)
 

@@ -44,6 +44,11 @@ def build_change_request(resp: EngineResponse) -> dict | None:
             }],
             "scored": True,
             "timestamp": int(time.time()),
+            # freshness key for same-(policy,rule,resource) merges: the
+            # second-resolution reference timestamp cannot order an
+            # admission result against a scan result produced moments
+            # later; stripped from emitted report rows
+            "timestampNs": time.time_ns(),
         })
     if not results:
         return None
@@ -98,6 +103,10 @@ class ReportGenerator:
         self._writer_wake = threading.Event()
         self._writer_stop = threading.Event()
         self._writer: threading.Thread | None = None
+        # True while the writer holds an item it popped but hasn't
+        # persisted: flush() and aggregate() must wait it out or that
+        # result is invisible to both the queue drain and the CR list
+        self._writing = False
         # current-state result store: (ns, policy, rule, kind, name) -> result.
         # Reports are REBUILT from this map each aggregate() — stored report
         # objects are replaced, never merged, so deleted policies/resources
@@ -142,31 +151,42 @@ class ReportGenerator:
 
     def _drain_queue(self) -> None:
         while self._queue:
+            # the flag goes up BEFORE the pop: between popleft and the
+            # write the item exists nowhere observable, and flush()/
+            # aggregate() must never see queue-empty + not-writing in
+            # that window
+            self._writing = True
             try:
-                rcr = self._queue.popleft()
-            except IndexError:
-                return
-            for attempt in (0, 1):
                 try:
-                    self._write_rcr(rcr)
-                    break
-                except Exception:
-                    # first failure may be a racing delete/conflict — the
-                    # retry re-gets; a second failure re-queues with a
-                    # breather so the result is never dropped
-                    if attempt == 1:
-                        self._queue.append(rcr)
-                        self._writer_stop.wait(0.5)
-                        return
+                    rcr = self._queue.popleft()
+                except IndexError:
+                    return
+                for attempt in (0, 1):
+                    try:
+                        self._write_rcr(rcr)
+                        break
+                    except Exception:
+                        # first failure may be a racing delete/conflict —
+                        # the retry re-gets; a second failure re-queues
+                        # with a breather so the result is never dropped
+                        if attempt == 1:
+                            self._queue.append(rcr)
+                            self._writing = False
+                            self._writer_stop.wait(0.5)
+                            return
+            finally:
+                self._writing = False
 
     def flush(self, timeout_s: float = 5.0) -> bool:
         """Block until every queued change request is persisted (tests,
-        shutdown). True when the queue drained."""
+        shutdown, and the leader before aggregation). True when both the
+        queue AND any in-flight write drained."""
         deadline = time.monotonic() + timeout_s
-        while self._queue and time.monotonic() < deadline:
+        while (self._queue or self._writing) and \
+                time.monotonic() < deadline:
             self._writer_wake.set()
-            time.sleep(0.01)
-        return not self._queue
+            time.sleep(0.005)
+        return not self._queue and not self._writing
 
     def stop(self) -> None:
         self._writer_stop.set()
@@ -220,13 +240,25 @@ class ReportGenerator:
         consumed: list[tuple] = []
         if self.client is not None and self.persist_requests:
             # the leader's OWN queued requests consume directly — writing
-            # them out only to immediately read them back buys nothing
+            # them out only to immediately read them back buys nothing.
+            # Hold them aside: they must apply AFTER the cluster-listed
+            # CRs (same-key merge is last-write-wins, and a local queued
+            # result is strictly fresher than this replica's own
+            # already-persisted CR — e.g. a scan FAIL queued after an
+            # admission PASS for the same resource must win)
+            local: list[dict] = []
             while self._queue:
                 try:
-                    with self._lock:
-                        self._pending.append(self._queue.popleft())
+                    local.append(self._queue.popleft())
                 except IndexError:
                     break
+            # an item the writer popped but hasn't persisted yet is in
+            # NEITHER the queue nor the cluster: wait it out, or this
+            # cycle's report silently misses a result that was produced
+            # before aggregation started
+            deadline = time.monotonic() + 2.0
+            while self._writing and time.monotonic() < deadline:
+                time.sleep(0.005)
             for kind in ("ReportChangeRequest", "ClusterReportChangeRequest"):
                 try:
                     items = list(self.client.list_resource(
@@ -239,6 +271,8 @@ class ReportGenerator:
                         self._pending.append(rcr)
                     consumed.append((kind, meta.get("namespace", ""),
                                      meta.get("name", "")))
+            with self._lock:
+                self._pending.extend(local)
         with self._lock:
             pending = self._pending
             self._pending = []
@@ -246,14 +280,29 @@ class ReportGenerator:
                 ns = (rcr.get("metadata") or {}).get("namespace", "")
                 for r in rcr.get("results") or []:
                     res = (r.get("resources") or [{}])[0]
-                    self._results[(ns, r.get("policy"), r.get("rule"),
-                                   res.get("kind"), res.get("name"))] = r
+                    key = (ns, r.get("policy"), r.get("rule"),
+                           res.get("kind"), res.get("name"))
+                    # freshest-wins by production time, NOT application
+                    # order: consumption interleavings (local queue vs
+                    # cluster CRs vs another replica) cannot be ordered
+                    # reliably, but the producing timestamp can — an
+                    # admission PASS must never bury a later scan FAIL,
+                    # and vice versa. Legacy rows without the ns stamp
+                    # rank as 0 (always replaceable).
+                    old = self._results.get(key)
+                    if old is not None and (old.get("timestampNs") or 0) > \
+                            (r.get("timestampNs") or 0):
+                        continue
+                    self._results[key] = r
             by_namespace: dict[str, list[dict]] = {
                 ns: [] for ns in self._known_ns
             }
             for (ns, *_), r in sorted(self._results.items(),
                                       key=lambda kv: kv[0]):
-                by_namespace.setdefault(ns, []).append(r)
+                # the freshness key is internal — report rows carry the
+                # reference's second-resolution timestamp only
+                by_namespace.setdefault(ns, []).append(
+                    {k: v for k, v in r.items() if k != "timestampNs"})
             self._known_ns.update(by_namespace)
 
         reports = []

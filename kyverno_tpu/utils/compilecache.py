@@ -28,11 +28,19 @@ def enable() -> None:
     if _enabled or os.environ.get("KTPU_COMPILE_CACHE", "1") == "0":
         return
     explicit = os.environ.get("KTPU_COMPILE_CACHE_DIR")
-    path = explicit or str(
-        Path(__file__).resolve().parents[2] / ".jax_compilation_cache")
     try:
         import jax
 
+        # XLA:CPU AOT reloads warn about machine-feature mismatches (and
+        # can SIGILL across hosts); CPU compiles are seconds anyway — the
+        # 20-40s wins are all on the accelerator side. Checked against
+        # the RESOLVED backend (env vars miss the no-accelerator
+        # fallback); enable() is called from the jit builders, where
+        # backend initialization is imminent regardless.
+        if jax.default_backend() == "cpu":
+            return
+        path = explicit or str(
+            Path(__file__).resolve().parents[2] / ".jax_compilation_cache")
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _enabled = True

@@ -403,3 +403,79 @@ class TestMultiReplicaReportFanIn:
         finally:
             r1.audit_handler.stop()
             r2.audit_handler.stop()
+
+
+class TestReportMergeOrdering:
+    def test_local_queued_result_wins_over_own_persisted_cr(self):
+        """Same (policy, rule, resource) key from two sources: an
+        already-persisted CR (older, e.g. an admission PASS) and a
+        locally queued result (newer, e.g. a scan FAIL). The merge is
+        last-write-wins, so the fresher local result must apply after
+        the cluster-listed CRs — the race behind the flaky lifecycle
+        e2e (a scan FAIL vanishing under an admission PASS)."""
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.reports import ReportGenerator
+
+        cluster = FakeCluster()
+        gen = ReportGenerator(client=cluster)
+
+        def rcr(result, ts):
+            return {
+                "apiVersion": "kyverno.io/v1alpha2",
+                "kind": "ReportChangeRequest",
+                "metadata": {"name": "rcr-p-pod-x", "namespace": "default"},
+                "results": [{
+                    "policy": "p", "rule": "r", "result": result,
+                    "message": "", "scored": True, "timestampNs": ts,
+                    "resources": [{"kind": "Pod", "namespace": "default",
+                                   "name": "x"}],
+                }],
+            }
+
+        # older result persisted as a CR (as the async writer would)
+        gen.add_change_request(rcr("pass", ts=100))
+        assert gen.flush()
+        assert cluster.list_resource("kyverno.io/v1alpha2",
+                                     "ReportChangeRequest")
+        # fresher result sits in the local queue at aggregate time: STOP
+        # the writer first so the queue item deterministically exercises
+        # the hold-aside merge (a live writer could persist it and make
+        # the test pass through the cluster path regardless)
+        gen.stop()
+        gen._queue.append(rcr("fail", ts=200))
+        built = gen.aggregate()
+        rows = [r for rep in built for r in rep.get("results", [])]
+        assert [r["result"] for r in rows] == ["fail"]
+
+    def test_freshest_timestamp_wins_regardless_of_order(self):
+        """The inverse interleaving: a FRESHER cluster CR must not be
+        buried by a staler held-aside local item — merge is by the
+        production timestamp, not application order."""
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.reports import ReportGenerator
+
+        cluster = FakeCluster()
+        gen = ReportGenerator(client=cluster)
+
+        def rcr(result, ts):
+            return {
+                "apiVersion": "kyverno.io/v1alpha2",
+                "kind": "ReportChangeRequest",
+                "metadata": {"name": "rcr-p-pod-x", "namespace": "default"},
+                "results": [{
+                    "policy": "p", "rule": "r", "result": result,
+                    "message": "", "scored": True, "timestampNs": ts,
+                    "resources": [{"kind": "Pod", "namespace": "default",
+                                   "name": "x"}],
+                }],
+            }
+
+        gen.add_change_request(rcr("fail", ts=300))   # fresher, persisted
+        assert gen.flush()
+        gen.stop()
+        gen._queue.append(rcr("pass", ts=100))        # staler, local
+        built = gen.aggregate()
+        rows = [r for rep in built for r in rep.get("results", [])]
+        assert [r["result"] for r in rows] == ["fail"]
+        # the internal freshness key never reaches emitted report rows
+        assert all("timestampNs" not in r for r in rows)
