@@ -262,3 +262,141 @@ class TestDeviceScreenRaces:
         assert not errors, errors[:3]
         for got in results:
             assert np.array_equal(got, want)
+
+
+class TestDecisionCacheRaces:
+    def test_concurrent_admissions_vs_policy_churn(self):
+        """The round-5 caches under fire: HTTP-less webhook admissions
+        (decision cache + screen-row cache) racing policy reloads (which
+        rotate the cache generation) and audit processing (audit memo).
+        Invariant: verdicts never cross policy generations — a pod that
+        violates the CURRENT policy set is never allowed."""
+        from kyverno_tpu.runtime.batch import AdmissionBatcher
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.policycache import PolicyCache
+        from kyverno_tpu.runtime.webhook import (
+            VALIDATING_WEBHOOK_PATH,
+            WebhookServer,
+        )
+
+        cache = PolicyCache()
+        cache.add(_policy("block-latest"))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=5.0)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        server.audit_handler.run()
+
+        def review(i):
+            bad = i % 2
+            return {"request": {
+                "uid": "u", "kind": {"kind": "Pod"},
+                "namespace": "default", "operation": "CREATE",
+                "object": {"apiVersion": "v1", "kind": "Pod",
+                           "metadata": {"name": f"p{i % 5}",
+                                        "namespace": "default"},
+                           "spec": {"containers": [{
+                               "name": "c",
+                               "image": "nginx:latest" if bad
+                               else "nginx:1.21"}]}}}}, bad
+
+        def admit(i):
+            body, bad = review(i)
+            out = server.handle(VALIDATING_WEBHOOK_PATH, body)
+            # the enforce policy (present in every generation) must
+            # always deny :latest — cached or not
+            if bad:
+                assert out["response"]["allowed"] is False
+            else:
+                assert out["response"]["allowed"] is True
+
+        def churn(i):
+            # add/remove a SEMANTICALLY DISTINCT policy: generations must
+            # rotate every cache key, and a stale cross-generation verdict
+            # would be observably wrong (':dev' rejection appearing or
+            # vanishing), not coincidentally identical
+            extra = _policy(f"extra-{i % 2}", image_pat="!*:dev")
+            cache.add(extra)
+            cache.remove(extra)
+
+        def audit(i):
+            body, _ = review(i)
+            server._process_audit(body["request"])
+
+        try:
+            errors = race([admit, admit, admit, churn, audit],
+                          duration_s=1.5)
+        finally:
+            server.audit_handler.stop()
+            batcher.stop()
+        assert not errors, errors[:3]
+        # staleness probe: the ':dev'-blocking policy is GONE now, so a
+        # ':dev' pod must be allowed — a decision cached under a
+        # generation that still had the policy must not leak forward
+        probe = {"request": {
+            "uid": "u", "kind": {"kind": "Pod"},
+            "namespace": "default", "operation": "CREATE",
+            "object": {"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p0", "namespace": "default"},
+                       "spec": {"containers": [{
+                           "name": "c", "image": "nginx:dev"}]}}}}
+        out = server.handle(VALIDATING_WEBHOOK_PATH, probe)
+        assert out["response"]["allowed"] is True
+
+
+class TestReportWriterRaces:
+    def test_writer_vs_aggregate_vs_flush(self):
+        """The async RCR writer, leader aggregation, and flush running
+        concurrently: no exceptions, no deadlock, and every produced
+        result eventually lands in exactly one report row (freshest
+        per key)."""
+        from kyverno_tpu.runtime.client import FakeCluster
+        from kyverno_tpu.runtime.reports import ReportGenerator
+
+        gen = ReportGenerator(FakeCluster())
+
+        def rcr(i):
+            return {
+                "apiVersion": "kyverno.io/v1alpha2",
+                "kind": "ReportChangeRequest",
+                "metadata": {"name": f"rcr-pol-pod-p{i % 7}",
+                             "namespace": "default"},
+                "results": [{
+                    "policy": "pol", "rule": "r",
+                    "result": "fail" if i % 2 else "pass",
+                    "message": "", "scored": True,
+                    "timestampNs": time.time_ns(),
+                    "resources": [{"kind": "Pod", "namespace": "default",
+                                   "name": f"p{i % 7}"}],
+                }],
+            }
+
+        def add(i):
+            gen.add_change_request(rcr(i))
+
+        def aggregate(i):
+            for report in gen.aggregate():
+                summary = report.get("summary") or {}
+                results = report.get("results") or []
+                assert sum(summary.values()) == len(results)
+
+        def flush(i):
+            gen.flush(timeout_s=0.5)
+
+        try:
+            errors = race([add, add, aggregate, flush], duration_s=1.5)
+            # worker errors are the root cause — report them FIRST
+            assert not errors, errors[:3]
+            # quiesce, then the final aggregate holds one row per key
+            assert gen.flush()
+            reports = gen.aggregate()
+            rows = [r for rep in reports for r in rep.get("results", [])]
+            keys = [(r["policy"], r["rule"],
+                     r["resources"][0]["name"]) for r in rows]
+            assert len(keys) == len(set(keys))
+            assert len(keys) <= 7
+        finally:
+            gen.stop()
