@@ -423,9 +423,18 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # chain failures: a cleanly absent ANCESTOR, or a null-break AT
             # the anchored key's level — the parent of the anchor exists
             # but is not a map, a structural FAIL the reference raises
-            # before the anchor handler runs
+            # before the anchor handler runs. An equality-GUARDED absent
+            # ancestor is NOT a failure: =(key) absence makes the whole
+            # subtree vacuous, so an anchor nested under it is never
+            # reached (fuzz seed 70: {=(mode): {<(g): ...}} with mode
+            # absent must pass, not fail)
+            # ...but the guard rescues only a CLEANLY absent key: a chain
+            # that null-breaks at the guarded depth means its parent
+            # exists as a scalar/list — a structural type-mismatch FAIL
+            # in the reference, same convention as absent_ok/nil_leaf
             cond_chain_fail_slot = (
-                ((first_absent != 0) & (first_absent < cond_bit) & valid_c)
+                ((first_absent != 0) & (first_absent < cond_bit)
+                 & ~(guard_pass & ~nbrk_c) & valid_c)
                 | (nbrk_c & (first_absent == cond_bit) & valid_c))
             cond_chain_g = _segment_or(
                 jnp.where(c_is_cond[:, None],

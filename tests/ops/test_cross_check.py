@@ -453,3 +453,49 @@ def test_full_evaluate_matches_oracle(policy_set, corpus):
     verdicts = policy_set.evaluate(corpus[:30])
     oracle = oracle_matrix(policy_set, corpus[:30])
     assert (verdicts == oracle).all()
+
+
+def test_global_anchor_under_absent_equality_anchor():
+    """{=(mode): {<(g): pattern}} with mode ABSENT: the equality anchor
+    makes the whole subtree vacuous — the nested global anchor is never
+    reached, so the rule must PASS (not fail, not skip). Device and
+    oracle must agree on every structural variant (fuzz seed 70)."""
+    def both(pattern, resource):
+        pol = load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "p"}, "spec": {"rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["*"]}},
+                "validate": {"pattern": pattern}}]},
+        })
+        cps = CompiledPolicySet([pol])
+        device = Verdict(
+            np.asarray(cps.evaluate_device(cps.flatten([resource])))[0, 0])
+        ctx = Context()
+        ctx.add_resource(resource)
+        resp = oracle_validate(PolicyContext(
+            policy=pol, new_resource=resource, json_context=ctx))
+        return device, resp.policy_response.rules[0].status.value
+
+    res = {"apiVersion": "v1", "kind": "Secret", "metadata": {"name": "x"},
+           "data": {"gamma": [True]}}
+    # absent =(mode): vacuous subtree, nested global never evaluated
+    device, oracle = both({"data": {"=(mode)": {"<(data)": "<1"}}}, res)
+    assert (device, oracle) == (Verdict.PASS, "pass")
+    # present =(gamma): the nested global IS evaluated and fails the rule
+    device, oracle = both({"data": {"=(gamma)": {"<(data)": "<1"}}}, res)
+    assert oracle == "fail" and device in (Verdict.FAIL, Verdict.HOST)
+    # ancestor above the eq anchor absent: plain FAIL on both lanes
+    device, oracle = both({"stuff": {"=(mode)": {"<(data)": "<1"}}}, res)
+    assert oracle == "fail" and device in (Verdict.FAIL, Verdict.HOST)
+    # eq key present but scalar: structural FAIL before the anchor runs
+    res2 = {"apiVersion": "v1", "kind": "Secret", "metadata": {"name": "x"},
+            "data": {"mode": "scalar"}}
+    device, oracle = both({"data": {"=(mode)": {"<(data)": "<1"}}}, res2)
+    assert oracle == "fail" and device in (Verdict.FAIL, Verdict.HOST)
+    # the eq-anchored key's PARENT is a scalar: the chain null-breaks AT
+    # the guarded depth — the guard must NOT rescue it (the reference
+    # type-mismatches on the parent before the anchor is considered)
+    res3 = {"apiVersion": "v1", "kind": "Secret", "metadata": {"name": "x"},
+            "data": "scalar"}
+    device, oracle = both({"data": {"=(mode)": {"<(data)": "<1"}}}, res3)
+    assert oracle == "fail" and device in (Verdict.FAIL, Verdict.HOST)

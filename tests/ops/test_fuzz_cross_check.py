@@ -147,8 +147,11 @@ def rand_resource(rng, i):
     }
 
 
-@pytest.mark.parametrize("seed", list(range(1, 65)))
+@pytest.mark.parametrize("seed", list(range(1, 65)) + [70, 114, 142])
 def test_fuzz_device_matches_oracle(seed):
+    # 70/114/142: an extended 256-seed sweep found anchors nested under
+    # an ABSENT equality anchor over-failing on device (the cond-row
+    # chain-failure mask ignored the =() guard bits); pinned forever
     rng = random.Random(20260730 + seed)
     policies = [rand_policy(rng, i) for i in range(10)]
     resources = [rand_resource(rng, i) for i in range(40)]
