@@ -66,6 +66,10 @@ class CheckOp(IntEnum):
     ABSENT = 13         # negation anchor: path must not exist
     EXISTS_NONNIL = 14  # DefaultHandler "*": key present and non-null
                         # (anchor/anchor.go:118)
+    EXISTS_LIST = 15    # gated list with no sibling fields: the list
+                        # itself must exist AS a list; its elements are
+                        # vacuous (every element matches-and-has-no-rest
+                        # or is condition-skipped)
 
 
 class CheckAnchor(IntEnum):
@@ -274,6 +278,19 @@ class _PatternCompiler:
 
     def _walk_map(self, pattern: dict, path: str, gate: int, array_depth: int,
                   guard: int) -> None:
+        # a skip-capable anchor (condition/global) SHARING a map level
+        # with any other anchor is order-dependent in the reference:
+        # validateMap runs anchor handlers in key order and the FIRST to
+        # error decides skip-vs-fail for the rule (validate.go:102-137)
+        # — a lattice without ordering cannot express that; the oracle
+        # decides (deep-fuzz finding). Anchors that only fail-or-pass
+        # (=, X, ^) commute and stay on device.
+        kinds_here = [anchor_kind(k) for k in pattern
+                      if anchor_kind(k) is not Anchor.NONE]
+        if (len(kinds_here) > 1
+                and any(k in (Anchor.CONDITION, Anchor.GLOBAL)
+                        for k in kinds_here)):
+            raise HostOnly("skip-capable anchor sharing a map level")
         for key, value in pattern.items():
             kind = anchor_kind(key)
             bare, _ = remove_anchor(key)
@@ -305,7 +322,7 @@ class _PatternCompiler:
             elif kind is Anchor.EXISTENCE:
                 if array_depth > 0:
                     raise HostOnly("existence anchor inside an array")
-                self._walk_existence(value, child_path)
+                self._walk_existence(value, child_path, guard)
             elif kind is Anchor.ADD_IF_NOT_PRESENT:
                 raise HostOnly("+() anchor is mutate-only")
             elif value == "*":
@@ -359,15 +376,36 @@ class _PatternCompiler:
             if gates:
                 if array_depth > 0:
                     raise HostOnly("element gates in nested arrays")
+                if any(anchor_kind(k) is Anchor.GLOBAL for k in gates):
+                    # <() in an array element is NOT an element filter: a
+                    # predicate mismatch on any element skips the whole
+                    # RULE (GlobalConditionError propagates out of
+                    # validateArrayOfMaps), an order-dependent semantic
+                    # the gate lattice cannot express — oracle decides
+                    raise HostOnly("global anchor in array element")
+                rest = {k: v for k, v in element.items() if k not in gates}
+                if not rest:
+                    # pure-filter element ({(cond): pat} and nothing
+                    # else): every element either condition-skips or
+                    # trivially matches, so the constraints left are the
+                    # LIST's own presence/type (deep-fuzz find: the gate
+                    # alone let an ABSENT list pass) and that every
+                    # element IS a map — a scalar element is a type
+                    # mismatch the reference fails before the anchor
+                    # handler runs (validateResourceElement dispatch)
+                    self._emit(CheckIR(path=path, op=CheckOp.EXISTS_LIST,
+                                       gate=-1, guard_mask=guard))
+                    self._emit(CheckIR(path=elem_path,
+                                       op=CheckOp.EXISTS_OBJECT,
+                                       gate=-1, guard_mask=guard))
+                    return
                 gate_id = self.rule.n_gates
                 self.rule.n_gates += 1
                 self.rule.gate_prefix[gate_id] = elem_path
                 for key in gates:
                     bare, _ = remove_anchor(key)
                     self._compile_gate_predicate(element[key], f"{elem_path}{SEP}{bare}", gate_id)
-                rest = {k: v for k, v in element.items() if k not in gates}
-                if rest:
-                    self._walk_map(rest, elem_path, gate_id, array_depth + 1, guard)
+                self._walk_map(rest, elem_path, gate_id, array_depth + 1, guard)
             else:
                 self._compile_subtree(element, elem_path, anchor, -1,
                                       array_depth + 1, guard)
@@ -382,10 +420,12 @@ class _PatternCompiler:
             raise HostOnly("non-scalar element gate predicate")
         self._emit_leaf(value, path, CheckAnchor.ELEMENT_GATE, gate_id)
 
-    def _walk_existence(self, value, path: str) -> None:
+    def _walk_existence(self, value, path: str, guard: int = 0) -> None:
         """^(key): [pattern] -> at least one element matches. Compiled as an
         OR-over-elements group; only a single scalar-leaf predicate or a
-        flat map of scalars is supported on device."""
+        flat map of scalars is supported on device. ``guard`` carries
+        equality-anchor bits from ancestors: an absent =() key makes the
+        existence check vacuous too."""
         if not isinstance(value, list) or len(value) != 1:
             raise HostOnly("existence anchor expects a single-element list")
         element = value[0]
@@ -399,10 +439,11 @@ class _PatternCompiler:
                     raise HostOnly("nested existence anchor")
                 self._emit_leaf(
                     v, f"{elem_path}{SEP}{k}", CheckAnchor.NONE, -1,
-                    existence_group=group,
+                    existence_group=group, guard=guard,
                 )
         else:
-            self._emit_leaf(element, elem_path, CheckAnchor.NONE, -1, existence_group=group)
+            self._emit_leaf(element, elem_path, CheckAnchor.NONE, -1,
+                            existence_group=group, guard=guard)
 
     # ---------------------------------------------------------------- leaves
 

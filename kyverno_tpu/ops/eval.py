@@ -301,6 +301,7 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                     op == CheckOp.IS_NULL,
                     op == CheckOp.EXISTS_OBJECT,
                     op == CheckOp.EXISTS_NONNIL,
+                    op == CheckOp.EXISTS_LIST,
                     op == CheckOp.ABSENT,
                 ],
                 [
@@ -322,6 +323,7 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                     | ((type_c == T_STR) & empty_str[jnp.maximum(sid_c, 0)] & has_sid),
                     type_c == T_OBJ,
                     leaf_present & (type_c != T_NULL),
+                    type_c == T_LIST,
                     jnp.ones_like(leaf_present),  # handled below
                 ],
                 default=jnp.zeros_like(leaf_present),
@@ -396,7 +398,11 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
             # and the anchored key itself is missing; a null-broken chain
             # or a missing ancestor is a structural FAIL before the
             # existence handler runs
-            exist_clean_miss = (first_absent == (1 << jnp.maximum(tr0, 0))) & ~nbrk_c
+            # ...or an equality-guarded ancestor is cleanly absent: the
+            # =() anchor makes the whole subtree (existence included)
+            # vacuous, same rescue as plain rows
+            exist_clean_miss = ((first_absent == (1 << jnp.maximum(tr0, 0)))
+                                | guard_pass) & ~nbrk_c
             exist_absent_ok = ((exist_clean_miss | ~valid_c).all(axis=2)
                                & valid_c.any(axis=2))
             check_ok = jnp.where(c_exist[None, :],
@@ -535,7 +541,8 @@ def build_eval_fn(tensors: PolicyTensors, jit: bool = True):
                            (nbrk_c & valid_c).any(axis=2))
             is_value_check = ~((op == CheckOp.ABSENT)
                                | (op == CheckOp.EXISTS_OBJECT)
-                               | (op == CheckOp.EXISTS_NONNIL))[:, :, 0]
+                               | (op == CheckOp.EXISTS_NONNIL)
+                               | (op == CheckOp.EXISTS_LIST))[:, :, 0]
             list_leaf = (is_value_check &
                          ((type_c == T_LIST) & leaf_present & valid_c).any(axis=2))
             unc_rows = gate_key_absent | list_leaf

@@ -177,3 +177,52 @@ def test_fuzz_device_matches_oracle(seed):
                      Verdict(oracle[b, r]).name, got.name,
                      ref.policy.raw["spec"]["rules"][0], resources[b]))
     assert not mismatches, f"{len(mismatches)}; first: {mismatches[0]}"
+
+
+def deep_pattern(rng, depth=0):
+    """Depth-3, anchor-dense grammar: the round-5 sweep that found the
+    gated-list presence hole, the global-anchor-in-array skip semantics,
+    the existence-under-equality guard, and the order-dependent
+    multi-anchor levels — all shapes the depth-2 grammar cannot emit."""
+    if depth >= 3 or rng.random() < 0.3:
+        return rand_leaf_pattern(rng)
+    if rng.random() < 0.2:
+        return [deep_pattern(rng, depth + 1)]
+    out = {}
+    for _ in range(rng.randint(1, 3)):
+        key = rng.choice(KEYS)
+        if rng.random() < 0.45:
+            kind = rng.choice(["(", "^(", "=(", "X(", "<(", "=(", "<("])
+            key = f"{kind}{key})"
+        out[key] = deep_pattern(rng, depth + 1)
+    return out
+
+
+# 16 fresh seeds + every seed that ever found a divergence
+@pytest.mark.parametrize("seed", list(range(1, 17))
+                         + [46, 76, 83, 119, 190, 222])
+def test_deep_fuzz_device_matches_oracle(seed):
+    rng = random.Random(77000 + seed)
+    policies = [load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": f"deep-{i}"},
+        "spec": {"rules": [{
+            "name": f"dz-{i}",
+            "match": {"resources": {"kinds": [rng.choice(
+                ["Pod", "ConfigMap", "*"])]}},
+            "validate": {"pattern": {"data": deep_pattern(rng)}}}]}})
+        for i in range(10)]
+    resources = [rand_resource(rng, i) for i in range(40)]
+    cps = CompiledPolicySet(policies)
+    device = np.asarray(cps.evaluate_device(cps.flatten(resources)))
+    oracle = oracle_matrix(cps, resources)
+    mismatches = []
+    for b in range(len(resources)):
+        for r in range(cps.tensors.n_rules):
+            got = Verdict(device[b, r])
+            if got == Verdict.HOST:
+                continue
+            if got != Verdict(oracle[b, r]):
+                mismatches.append((seed, b, r, Verdict(oracle[b, r]).name,
+                                   got.name))
+    assert not mismatches, f"{len(mismatches)}; first: {mismatches[0]}"
