@@ -130,47 +130,118 @@ class CompiledPolicySet:
         return self.resolve_host_cells(resources, verdicts)
 
     def resolve_host_cells(self, resources: list[dict],
-                           verdicts: np.ndarray) -> np.ndarray:
+                           verdicts: np.ndarray,
+                           contexts: list | None = None,
+                           rule_filter=None,
+                           messages_out: dict | None = None) -> np.ndarray:
         """Replace Verdict.HOST cells with CPU-oracle verdicts, in place.
 
-        Shared by the single-chip path and the mesh path (parallel/mesh.py
-        sharded_scan) so host-lane rules are never silently dropped."""
+        Shared by the single-chip path, the mesh path (parallel/mesh.py
+        sharded_scan) and the admission flush (runtime/batch.py) so
+        host-lane rules are never silently dropped.
+
+        ``contexts`` (optional, aligned with ``resources``) carries the
+        per-resource admission payload — ``{"request", "namespace_labels",
+        "roles", "cluster_roles", "exclude_group_role"}`` — so host-lane
+        rules that read ``request.*``/userinfo resolve faithfully instead
+        of against a bare resource-only context. ``rule_filter`` (a
+        container of rule indices) limits resolution to eligible rules:
+        cells outside it stay HOST for the caller to escalate.
+        ``messages_out`` (optional dict) receives the oracle's message per
+        resolved cell, keyed ``(batch_row, rule_index)``."""
         host_cells = np.argwhere(verdicts == Verdict.HOST)
         if host_cells.size:
             by_resource: dict[int, list[int]] = {}
             for b, r in host_cells:
+                if rule_filter is not None and int(r) not in rule_filter:
+                    continue
                 by_resource.setdefault(int(b), []).append(int(r))
             for b, rule_rows in by_resource.items():
-                oracle = self._oracle_verdicts(resources[b], rule_rows)
-                for r, v in oracle.items():
+                context = contexts[b] if contexts is not None else None
+                oracle = self._oracle_verdicts(resources[b], rule_rows,
+                                               context=context)
+                for r, (v, msg) in oracle.items():
                     verdicts[b, r] = v
+                    if messages_out is not None:
+                        messages_out[(b, r)] = msg
         return verdicts
 
-    def _oracle_verdicts(self, resource: dict, rule_rows: list[int]) -> dict[int, int]:
-        """Run the CPU oracle for specific rules of one resource.
+    def _request_policy_context(self, resource: dict, payload: dict):
+        """Request-aware PolicyContext for host-cell resolution — the same
+        recipe the oracle pool workers use (oracle_pool._worker_evaluate),
+        so a flush-resolved verdict matches what the inline webhook oracle
+        would have produced for this admission."""
+        from ..engine.match import AdmissionUserInfo, RequestInfo
+
+        request = payload.get("request") or {}
+        jctx = Context()
+        if request:
+            jctx.add_request(request)
+        if resource:
+            jctx.add_resource(resource)
+        old = request.get("oldObject") or {}
+        if old:
+            jctx.add_old_resource(old)
+        user_info = request.get("userInfo") or {}
+        roles = payload.get("roles") or []
+        cluster_roles = payload.get("cluster_roles") or []
+        jctx.add_user_info({"roles": roles, "clusterRoles": cluster_roles,
+                            "userInfo": user_info})
+        username = user_info.get("username", "")
+        if username:
+            jctx.add_service_account(username)
+        try:
+            jctx.add_image_info(resource)
+        except Exception:
+            pass
+        return PolicyContext(
+            new_resource=resource,
+            old_resource=old,
+            json_context=jctx,
+            namespace_labels=payload.get("namespace_labels") or {},
+            exclude_group_role=payload.get("exclude_group_role") or [],
+            admission_info=RequestInfo(
+                roles=roles, cluster_roles=cluster_roles,
+                admission_user_info=AdmissionUserInfo(
+                    username=username, uid=user_info.get("uid", ""),
+                    groups=user_info.get("groups") or [])))
+
+    def _oracle_verdicts(self, resource: dict, rule_rows: list[int],
+                         context: dict | None = None) -> dict:
+        """Run the CPU oracle for specific rules of one resource; returns
+        ``{rule_index: (Verdict, message)}``.
 
         Namespaced Policy objects only apply inside their own namespace;
         oracle_validate applies that gate engine-side (validation._matches,
         utils.go:272 semantics), matching the device match program."""
-        out: dict[int, int] = {}
+        out: dict[int, tuple] = {}
         by_policy: dict[int, list[RuleRef]] = {}
         for r in rule_rows:
             ref = self.rule_refs[r]
             by_policy.setdefault(id(ref.policy), []).append(ref)
+        pctx = None
+        if context is not None:
+            pctx = self._request_policy_context(resource, context)
         for refs in by_policy.values():
             policy = refs[0].policy
-            jctx = Context()
-            jctx.add_resource(resource)
-            resp = oracle_validate(
-                PolicyContext(policy=policy, new_resource=resource, json_context=jctx)
-            )
-            statuses = {rr.name: rr.status for rr in resp.policy_response.rules}
+            if pctx is not None:
+                pctx.policy = policy
+                resp = oracle_validate(pctx)
+            else:
+                jctx = Context()
+                jctx.add_resource(resource)
+                resp = oracle_validate(
+                    PolicyContext(policy=policy, new_resource=resource,
+                                  json_context=jctx)
+                )
+            rows = {rr.name: rr for rr in resp.policy_response.rules}
             for ref in refs:
-                status = statuses.get(ref.rule.name)
-                if status is None:
-                    out[ref.rule_index] = Verdict.NOT_APPLICABLE
+                rr = rows.get(ref.rule.name)
+                if rr is None:
+                    out[ref.rule_index] = (Verdict.NOT_APPLICABLE, "")
                 else:
-                    out[ref.rule_index] = _STATUS_TO_VERDICT[status]
+                    out[ref.rule_index] = (_STATUS_TO_VERDICT[rr.status],
+                                           rr.message)
         return out
 
 

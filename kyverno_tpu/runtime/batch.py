@@ -82,7 +82,9 @@ class _Bucket:
 
     def __init__(self, cps):
         self.cps = cps
-        self.items: list[tuple[dict, Future]] = []
+        # (resource, ctx_cb | None, Future): ctx_cb lazily builds the
+        # admission context payload a flush needs to resolve HOST cells
+        self.items: list[tuple] = []
         self.seq = next(self._seq)    # stable identity (id() gets reused)
 
 
@@ -99,7 +101,8 @@ class AdmissionBatcher:
                  circuit_timeout_threshold: int = 3,
                  circuit_cooldown_s: float = 5.0,
                  result_cache_ttl_s: float = 1.0,
-                 result_cache_max: int = 4096):
+                 result_cache_max: int = 4096,
+                 resolve_host_in_flush: bool = True):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
@@ -154,6 +157,11 @@ class AdmissionBatcher:
         self.circuit_cooldown_s = circuit_cooldown_s
         self.stats = {"oracle": 0, "device": 0, "probe": 0,
                       "clean": 0, "attention": 0}
+        # flush-level HOST-cell resolution: cluster-independent host-lane
+        # rules (oracle_pool.pool_safe policies) resolve in ONE batched
+        # oracle pass per flush instead of per-request full evaluations in
+        # the webhook — the screen's answer becomes decisive for them
+        self.resolve_host_in_flush = resolve_host_in_flush
         # short-TTL screen-result cache: admission bursts are dominated by
         # near-identical resources (a Deployment scaling N replicas
         # submits N near-identical Pods), and the screen row is a pure
@@ -361,7 +369,7 @@ class AdmissionBatcher:
         key = self._cache_key(ptype, kind, namespace, resource, env)
         if key is None:
             return
-        clean = all(v in (Verdict.PASS, Verdict.SKIP) for _, _, v in row)
+        clean = all(t[2] in (Verdict.PASS, Verdict.SKIP) for t in row)
         with self._lock:
             self._cache_store(key, CLEAN if clean else ATTENTION, row)
 
@@ -369,8 +377,19 @@ class AdmissionBatcher:
 
     def screen(self, ptype, kind: str, namespace: str, resource: dict,
                timeout_s: float = SCREEN_DEADLINE_S,
-               env: dict | None = None, deadline_free: bool = False):
-        """Returns (CLEAN | ATTENTION | ORACLE, [(policy, rule, Verdict), ...]).
+               env: dict | None = None, deadline_free: bool = False,
+               ctx_cb=None):
+        """Returns (CLEAN | ATTENTION | ORACLE,
+        [(policy, rule, Verdict, message), ...]).
+
+        ``message`` is non-empty only for cells the flush resolved through
+        the batched host oracle (faithful oracle text the caller can deny
+        with directly); device-computed cells carry "".
+
+        ``ctx_cb`` (optional, zero-arg) lazily builds this admission's
+        context payload ({"request", "namespace_labels", "roles",
+        "cluster_roles", "exclude_group_role"}) — only invoked when the
+        flush actually has HOST cells to resolve for this row.
 
         ORACLE means "the device does not pay for this request — evaluate
         on CPU inline"; the caller treats it exactly like ATTENTION but no
@@ -435,14 +454,14 @@ class AdmissionBatcher:
                         b = self._buckets.get(pkey)
                         if b is None:
                             b = self._buckets[pkey] = _Bucket(cps)
-                        b.items.append((resource, Future()))
+                        b.items.append((resource, None, Future()))
                         self._lock.notify()
                     self.stats["oracle"] += 1
                     return ORACLE, []
             self.stats["device"] += 1
             if bucket is None:
                 bucket = self._buckets[key] = _Bucket(cps)
-            bucket.items.append((resource, fut))
+            bucket.items.append((resource, ctx_cb, fut))
             self._lock.notify()
             # bound the wrong-way cost: if the dispatch estimate turns out
             # optimistic, bail to the oracle after ~4x the expected RTT
@@ -517,11 +536,31 @@ class AdmissionBatcher:
                     self._lock.wait()
                 if self._stopped:
                     for b in self._buckets.values():
-                        for _, fut in b.items:
+                        for *_, fut in b.items:
                             fut.set_result((ATTENTION, [], False))
                     return
-            # micro-batch window: let concurrent requests pile in
-            time.sleep(self.window_s)
+            # adaptive micro-batch window: let concurrent requests pile
+            # in, but flush EARLY once every admission the router knows
+            # about has joined (queued >= in-flight) or the batch is full
+            # — at low depth there is nothing left to wait for, and the
+            # full 4ms window would be pure added latency
+            deadline = time.monotonic() + self.window_s
+            with self._lock:
+                while not self._stopped:
+                    queued = sum(len(b.items)
+                                 for b in self._buckets.values())
+                    if queued >= self.max_batch:
+                        self.stats["flush_early_full"] = (
+                            self.stats.get("flush_early_full", 0) + 1)
+                        break
+                    if 0 < self._in_flight <= queued:
+                        self.stats["flush_early_joined"] = (
+                            self.stats.get("flush_early_joined", 0) + 1)
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._lock.wait(timeout=remaining)
             with self._lock:
                 work = [(b.cps, b.items[:self.max_batch],
                          k and k[-1] == "probe")
@@ -551,11 +590,11 @@ class AdmissionBatcher:
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
-            for _, fut in items:
+            for *_, fut in items:
                 # waiters whose adaptive deadline expires while this
                 # flush is under way keep waiting (screen() checks this)
                 fut.ktpu_started = True
-            resources = [r for r, _ in items]
+            resources = [r for r, _, _ in items]
             t0 = time.monotonic()
             cpu0 = time.thread_time()
             # bucket the batch shape (pow2 + admission floor) so XLA
@@ -569,7 +608,7 @@ class AdmissionBatcher:
                 # this flush is about to pay XLA compilation — release the
                 # waiters to the oracle now and let the compile warm the
                 # bucket in the background for the next burst
-                for _, fut in items:
+                for *_, fut in items:
                     if not fut.done():
                         # cold-fallback release: the device did NOT answer
                         fut.set_result((ATTENTION, [], False))
@@ -598,22 +637,144 @@ class AdmissionBatcher:
                     self._batch_size_ema += 0.3 * (len(items)
                                                    - self._batch_size_ema)
                 self._last_dispatch = time.monotonic()
-            for b, (_, fut) in enumerate(items):
+            # batched HOST-cell resolution: every cluster-independent
+            # host-lane cell of the whole flush resolves through ONE
+            # oracle pass (request-aware contexts from the waiters'
+            # ctx_cb), so a row whose only flags were pool-safe host
+            # rules comes back CLEAN/FAIL-with-message instead of
+            # dumping each waiter onto a per-request full evaluation
+            messages: dict = {}
+            host_resolved = 0
+            live = any(not fut.done() for *_, fut in items)
+            if self.resolve_host_in_flush and live and not is_probe:
+                host_resolved = self._resolve_flush_hosts(
+                    cps, items, resources, verdicts, messages)
+            flush_cells: dict[str, int] = {}
+            flagged_rules: dict[str, int] = {}
+            esc: dict[str, int] = {}
+            for b, (_, _, fut) in enumerate(items):
                 row = []
                 clean = True
+                saw = {"host": False, "error": False, "fail": False}
                 for ref in cps.rule_refs:
                     v = Verdict(verdicts[b, ref.rule_index])
                     if v is Verdict.NOT_APPLICABLE:
                         continue
-                    row.append((ref.policy.name, ref.rule.name, v))
+                    msg = messages.get((b, ref.rule_index), "")
+                    row.append((ref.policy.name, ref.rule.name, v, msg))
+                    flush_cells[v.name] = flush_cells.get(v.name, 0) + 1
                     if v not in (Verdict.PASS, Verdict.SKIP):
                         clean = False
+                        flagged_rules[ref.rule.name] = (
+                            flagged_rules.get(ref.rule.name, 0) + 1)
+                        if v is Verdict.HOST:
+                            saw["host"] = True
+                        elif v is Verdict.ERROR:
+                            saw["error"] = True
+                        else:
+                            saw["fail"] = True
+                # escalation reason, most-blocking first: an unresolved
+                # HOST cell forces the webhook's oracle no matter what
+                # else the row says; ERROR next; FAIL may still deny
+                # directly from the device row
+                if clean:
+                    reason = "clean"
+                elif saw["host"]:
+                    reason = "host_unresolved"
+                elif saw["error"]:
+                    reason = "device_error"
+                else:
+                    reason = "device_fail"
+                esc[reason] = esc.get(reason, 0) + 1
                 if not fut.done():
                     fut.set_result((CLEAN if clean else ATTENTION, row, True))
+            self._note_flush_stats(len(items), host_resolved, flush_cells,
+                                   flagged_rules, esc)
         except Exception:
-            for _, fut in items:
+            for *_, fut in items:
                 if not fut.done():
                     fut.set_result((ATTENTION, [], False))
+
+    def _host_eligible_rules(self, cps) -> frozenset:
+        """Rule indices whose policy the flush may resolve host-side:
+        cluster-independent policies only (oracle_pool.pool_safe) — a
+        policy that needs a live cluster client keeps its HOST cells and
+        escalates to the webhook's inline oracle. Cached on the compiled
+        set (one id per policy generation)."""
+        cached = getattr(cps, "_ktpu_host_eligible", None)
+        if cached is None:
+            from .oracle_pool import pool_safe
+
+            safe_by_policy: dict[int, bool] = {}
+            idx = set()
+            for ref in cps.rule_refs:
+                pid = id(ref.policy)
+                ok = safe_by_policy.get(pid)
+                if ok is None:
+                    ok = safe_by_policy[pid] = pool_safe(ref.policy)
+                if ok:
+                    idx.add(ref.rule_index)
+            cached = cps._ktpu_host_eligible = frozenset(idx)
+        return cached
+
+    def _resolve_flush_hosts(self, cps, items, resources, verdicts,
+                             messages: dict) -> int:
+        """One batched oracle pass over the flush's eligible HOST cells;
+        returns how many cells were resolved. Failures leave cells HOST
+        (the webhook's oracle lane remains the correctness backstop)."""
+        try:
+            eligible = self._host_eligible_rules(cps)
+            if not eligible:
+                return 0
+            v_live = verdicts[:len(items)]
+            host_cells = np.argwhere(v_live == Verdict.HOST)
+            rows_with_host = sorted({int(b) for b, r in host_cells
+                                     if int(r) in eligible})
+            if not rows_with_host:
+                return 0
+            contexts: list = [None] * len(items)
+            for b in rows_with_host:
+                cb = items[b][1]
+                if cb is not None:
+                    try:
+                        contexts[b] = cb()
+                    except Exception:
+                        contexts[b] = None
+            cps.resolve_host_cells(resources, v_live, contexts=contexts,
+                                   rule_filter=eligible,
+                                   messages_out=messages)
+            return len(messages)
+        except Exception:
+            return 0
+
+    def _note_flush_stats(self, batch_size: int, host_resolved: int,
+                          flush_cells: dict, flagged_rules: dict,
+                          esc: dict) -> None:
+        """Fold one flush's diagnostics into stats + the metrics registry
+        (the routing split must be observable in production, not just in
+        bench output)."""
+        with self._lock:
+            if host_resolved:
+                self.stats["host_cells_resolved"] = (
+                    self.stats.get("host_cells_resolved", 0) + host_resolved)
+            cells = self.stats.setdefault("flush_cells", {})
+            for k, n in flush_cells.items():
+                cells[k] = cells.get(k, 0) + n
+            flagged = self.stats.setdefault("flagged_rules", {})
+            for k, n in flagged_rules.items():
+                flagged[k] = flagged.get(k, 0) + n
+            for k, n in esc.items():
+                self.stats[f"esc_{k}"] = self.stats.get(f"esc_{k}", 0) + n
+        try:
+            from . import metrics as metrics_mod
+
+            reg = metrics_mod.registry()
+            metrics_mod.record_flush_batch(reg, batch_size,
+                                           host_resolved=host_resolved)
+            for k, n in esc.items():
+                metrics_mod.record_screen_escalation(reg, k, n)
+        except Exception:
+            pass
 
     def stop(self) -> None:
         with self._lock:

@@ -148,3 +148,25 @@ def record_admission_request(registry: MetricsRegistry, operation: str,
         "resource_kind": kind,
         "request_allowed": str(allowed).lower(),
     })
+
+
+def record_flush_batch(registry: MetricsRegistry, size: int,
+                       host_resolved: int = 0) -> None:
+    """Per-flush device batch observability (runtime/batch.py _flush):
+    realized batch size distribution plus how many HOST cells the flush
+    resolved in its batched oracle pass."""
+    registry.observe("kyverno_admission_flush_batch_size", {}, float(size))
+    if host_resolved:
+        registry.inc_counter("kyverno_admission_flush_host_cells_resolved_total",
+                             {}, float(host_resolved))
+
+
+def record_screen_escalation(registry: MetricsRegistry, reason: str,
+                             value: float = 1.0) -> None:
+    """Why a screened admission row escalated past CLEAN — the routing
+    split the bench reports, as a production counter. Reasons:
+    ``device_fail`` / ``device_error`` / ``host_unresolved`` (cells the
+    flush could not resolve device-side) and ``clean`` for rows that
+    short-circuited."""
+    registry.inc_counter("kyverno_admission_screen_escalations_total",
+                         {"reason": reason}, value)

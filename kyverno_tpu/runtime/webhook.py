@@ -312,11 +312,17 @@ class WebhookServer:
         meta = resource.get("metadata") or {}
         recorded: list[tuple] = []
         per_policy: dict[str, EngineResponse] = {}
-        for policy_name, rule_name, verdict in row:
+        for policy_name, rule_name, verdict, row_msg in row:
             status = batch_mod.verdict_to_status(verdict)
             if status is None:
                 continue
-            recorded.append((policy_name, rule_name, status.value))
+            # flush-resolved host cells carry the oracle's own text; a
+            # device PASS is the oracle's pattern-pass outcome — carry the
+            # same message either way so screened and oracle report rows
+            # agree
+            message = row_msg or (f"validation rule '{rule_name}' passed."
+                                  if status is RuleStatus.PASS else "")
+            recorded.append((policy_name, rule_name, status.value, message))
             metrics_mod.record_policy_results(
                 self.registry, policy_name, rule_name, status.value,
                 validation_mode=mode, resource_kind=kind,
@@ -333,10 +339,6 @@ class WebhookServer:
                             api_version=resource.get("apiVersion", ""),
                             namespace=meta.get("namespace", ""),
                             name=meta.get("name", ""))))
-            # a device PASS is the oracle's pattern-pass outcome: carry the
-            # same message text so screened and oracle report rows agree
-            message = (f"validation rule '{rule_name}' passed."
-                       if status is RuleStatus.PASS else "")
             resp.policy_response.rules.append(RuleResponse(
                 name=rule_name, type=RuleType.VALIDATION, status=status,
                 message=message))
@@ -349,31 +351,148 @@ class WebhookServer:
                 self.event_gen.add(*events_for_engine_response(resp))
         return recorded
 
-    def _device_deny_messages(self, policy, rule_verdicts):
+    def _reemit_report_rows(self, rows: list, resource: dict,
+                            request: dict) -> None:
+        """Replay cached decision rows into the report pipeline: a
+        decision-cache (or audit-memo) hit skips the engines, but a
+        reconcile() full rebuild during the hit window clears the result
+        store — without re-emission those rows vanish until the TTL
+        lapses. Same-key merge in the store is last-write-wins, so the
+        replay is idempotent. ``rows`` are ``(policy, rule, status_value,
+        message)`` as cached by _decision_store."""
+        if self.report_gen is None or not rows:
+            return
+        from ..engine.response import (
+            EngineResponse,
+            PolicyResponse,
+            PolicySpecSummary,
+            ResourceSpec,
+            RuleResponse,
+            RuleType,
+        )
+
+        ident = resource or request.get("oldObject") or {}
+        meta = ident.get("metadata") or {}
+        per_policy: dict[str, EngineResponse] = {}
+        for pn, rn, sv, msg in rows:
+            try:
+                status = RuleStatus(sv)
+            except ValueError:
+                continue
+            resp = per_policy.get(pn)
+            if resp is None:
+                resp = per_policy[pn] = EngineResponse(
+                    policy_response=PolicyResponse(
+                        policy=PolicySpecSummary(name=pn),
+                        resource=ResourceSpec(
+                            kind=ident.get("kind", ""),
+                            api_version=ident.get("apiVersion", ""),
+                            namespace=meta.get("namespace", ""),
+                            name=meta.get("name", ""))))
+            resp.policy_response.rules.append(RuleResponse(
+                name=rn, type=RuleType.VALIDATION, status=status,
+                message=msg))
+        for resp in per_policy.values():
+            self.report_gen.add(resp)
+
+    def _admission_ctx_payload(self, request: dict, namespace: str) -> dict:
+        """Context payload a flush needs to resolve this admission's HOST
+        cells request-faithfully (models/engine.resolve_host_cells) —
+        the same parent-side data gathering the oracle pool does. Built
+        lazily: the batcher only invokes the callback when the flush
+        actually has eligible HOST cells for this row."""
+        namespace_labels = {}
+        if namespace and self.resource_cache is not None:
+            try:
+                namespace_labels = self.resource_cache.get_namespace_labels(
+                    namespace)
+            except Exception:
+                namespace_labels = {}
+        roles: list = []
+        cluster_roles: list = []
+        try:
+            info = build_request_info(self.client,
+                                      request.get("userInfo") or {})
+            roles, cluster_roles = info.roles, info.cluster_roles
+        except Exception:
+            pass
+        return {"request": request, "namespace_labels": namespace_labels,
+                "roles": roles, "cluster_roles": cluster_roles,
+                "exclude_group_role": self.config.get_exclude_group_role()}
+
+    def _subst_context(self, request: dict, resource: dict):
+        """Admission-scoped substitution context for deny-message
+        variables: request.* and the resource resolve; anything needing
+        cluster state (roles, ns labels, external context) stays
+        unresolved and routes the policy to the oracle."""
+        from ..engine.context import Context
+
+        ctx = Context()
+        try:
+            if request:
+                ctx.add_request(request)
+            if resource:
+                ctx.add_resource(resource)
+            username = ((request or {}).get("userInfo") or {}).get(
+                "username", "")
+            if username:
+                ctx.add_service_account(username)
+            try:
+                ctx.add_image_info(resource)
+            except Exception:
+                pass
+        except Exception:
+            pass
+        return ctx
+
+    def _device_deny_messages(self, policy, rule_verdicts,
+                              request: dict | None = None,
+                              resource: dict | None = None):
         """Deny messages for a policy every one of whose flagged screen
-        cells is a device FAIL on a rule with a *static* validation
-        message — or None when any cell needs the oracle (HOST/ERROR
-        verdicts, ``{{..}}``/``$(..)`` in the message). The device
-        lattice already admits on all-PASS rows, so its FAIL on a
-        device-compiled rule carries the same authority; the oracle
-        would add only the failing path to the message text."""
+        cells is a FAIL the device row can answer — a flush-resolved host
+        cell carrying the oracle's own message, a rule with a *static*
+        validation message, or a variable message whose every variable
+        substitutes from the admission context (request.* / resource) —
+        or None when any cell still needs the oracle (HOST/ERROR
+        verdicts, ``$(..)`` references, variables needing cluster
+        state). The device lattice already admits on all-PASS rows, so
+        its FAIL on a device-compiled rule carries the same authority;
+        the oracle would add only the failing path to the message
+        text."""
+        from ..engine.variables import substitute_all
         from ..models import Verdict
 
         if policy is None:
             return None
         rules = {r.name: r for r in policy.spec.rules}
         msgs = []
-        for rname, v in rule_verdicts:
+        subst_ctx = None
+        for rname, v, resolved_msg in rule_verdicts:
             if v in (Verdict.PASS, Verdict.SKIP):
                 continue
             if v is not Verdict.FAIL:
                 return None
+            if resolved_msg:
+                # flush-resolved host cell: the oracle already produced
+                # the faithful failure text for this admission
+                msgs.append(f"policy {policy.name}/{rname}: {resolved_msg}")
+                continue
             rule = rules.get(rname)
             if rule is None:
                 return None
             msg = rule.validation.message or ""
-            if "{{" in msg or "$(" in msg:
+            if "$(" in msg:
                 return None
+            if "{{" in msg:
+                if subst_ctx is None:
+                    subst_ctx = self._subst_context(request or {},
+                                                    resource or {})
+                try:
+                    msg = substitute_all(subst_ctx, msg)
+                except Exception:
+                    return None
+                if not isinstance(msg, str) or "{{" in msg:
+                    return None
             if msg:
                 text = f"validation error: {msg} Rule {rname} failed"
             else:
@@ -403,11 +522,13 @@ class WebhookServer:
         # decision cache: a repeat of an identical admission (same policy
         # generation, resource bytes, requester identity) within the TTL
         # replays the decision + metrics without touching either engine
-        # lane. Report/event emission is skipped — for an identical
-        # (resource, outcomes) pair the aggregates are unchanged — while
-        # the semantically required side effects (audit queue, generate
-        # policies) still run below. Cluster-state context staleness is
-        # bounded by the TTL, the same window an informer lookup has.
+        # lane. Report rows are RE-EMITTED (idempotent per (policy, rule,
+        # resource) key) so a reconcile() full rebuild during the hit
+        # window cannot drop them; events are not — an identical
+        # (resource, outcomes) pair adds no new event. The semantically
+        # required side effects (audit queue, generate policies) still
+        # run below. Cluster-state context staleness is bounded by the
+        # TTL, the same window an informer lookup has.
         decision_key = None
         if enforce and self.admission_batcher is not None:
             decision_key = self.admission_batcher.decision_key(
@@ -417,11 +538,12 @@ class WebhookServer:
                    if decision_key is not None else None)
             if hit is not None and hit[0] > time.monotonic():
                 _, allowed, message, rows = hit
-                for pn, rn, sv in rows:
+                for pn, rn, sv, _msg in rows:
                     metrics_mod.record_policy_results(
                         self.registry, pn, rn, sv,
                         validation_mode="enforce", resource_kind=kind,
                         request_operation=request.get("operation", "CREATE"))
+                self._reemit_report_rows(rows, resource, request)
                 self.admission_batcher.stats["decision_cache"] = (
                     self.admission_batcher.stats.get("decision_cache", 0) + 1)
                 if not allowed:
@@ -434,18 +556,27 @@ class WebhookServer:
 
         # device screen (runtime/batch.py): micro-batched TPU evaluation;
         # an all-green row admits without touching the CPU engine, anything
-        # else drops to the oracle loop below for faithful messages
+        # else drops to the oracle loop below for faithful messages. The
+        # ctx_cb hands the flush this admission's context so pool-safe
+        # HOST cells resolve inside the flush's one batched oracle pass
         screened_clean = False
         screen_row: list = []
         if enforce and self.admission_batcher is not None:
             status, row = self.admission_batcher.screen(
                 PolicyType.VALIDATE_ENFORCE, kind, namespace, resource,
-                env=screen_env)
+                env=screen_env,
+                ctx_cb=lambda: self._admission_ctx_payload(request,
+                                                           namespace))
             if status == batch_mod.CLEAN:
                 screened_clean = True
                 metric_rows += self._record_screen_results(
                     row, resource, kind, request)
                 self.admission_batcher.note_screen_savings(1.0)
+                # per-REQUEST counter (device_deny counts per-policy
+                # messages): this admission was decided without the
+                # inline oracle
+                self.admission_batcher.stats["device_decided"] = (
+                    self.admission_batcher.stats.get("device_decided", 0) + 1)
             elif status == batch_mod.ATTENTION and row:
                 screen_row = row
 
@@ -461,22 +592,34 @@ class WebhookServer:
             if screen_row:
                 from ..models import Verdict
 
-                bad = {p for p, _, v in screen_row
+                bad = {p for p, _, v, _ in screen_row
                        if v not in (Verdict.PASS, Verdict.SKIP)}
                 by_name = {p.name: p for p in enforce}
                 direct: set = set()
                 for pname in bad:
                     msgs = self._device_deny_messages(
                         by_name.get(pname),
-                        [(r, v) for p, r, v in screen_row if p == pname])
+                        [(r, v, m) for p, r, v, m in screen_row
+                         if p == pname],
+                        request=request, resource=resource)
                     if msgs is None:
                         continue            # needs the oracle
                     direct.add(pname)
                     blocked_msgs += msgs
+                if direct:
+                    self.admission_batcher.stats["device_deny"] = (
+                        self.admission_batcher.stats.get("device_deny", 0)
+                        + len(direct))
                 metric_rows += self._record_screen_results(
                     [t for t in screen_row if t[0] not in bad - direct],
                     resource, kind, request)
                 run_policies = [p for p in enforce if p.name in bad - direct]
+                if not run_policies:
+                    # every flagged policy was answered from the device
+                    # row — a fully device-decided deny
+                    self.admission_batcher.stats["device_decided"] = (
+                        self.admission_batcher.stats.get("device_decided", 0)
+                        + 1)
             oracle_t0 = time.monotonic()
             # multicore lane: cluster-independent policies can evaluate in
             # a worker process (runtime/oracle_pool.py) — the GIL
@@ -494,7 +637,8 @@ class WebhookServer:
             for policy, resp in zip(run_policies, responses):
                 for rule in resp.policy_response.rules:
                     metric_rows.append(
-                        (policy.name, rule.name, rule.status.value))
+                        (policy.name, rule.name, rule.status.value,
+                         rule.message))
                     metrics_mod.record_policy_results(
                         self.registry, policy.name, rule.name,
                         rule.status.value,
@@ -538,7 +682,8 @@ class WebhookServer:
                         if v is None:          # WARN etc.: don't cache
                             cacheable = False
                             break
-                        full_row.append((policy.name, rule.name, v))
+                        full_row.append((policy.name, rule.name, v,
+                                         rule.message))
                 if cacheable:
                     self.admission_batcher.store_result(
                         PolicyType.VALIDATE_ENFORCE, kind, namespace,
@@ -568,8 +713,8 @@ class WebhookServer:
             return
         # WARN (audit-mode downgrades) and other exotic statuses carry
         # per-request semantics — don't cache those decisions
-        if any(sv not in ("pass", "fail", "skip", "error")
-               for _, _, sv in metric_rows):
+        if any(t[2] not in ("pass", "fail", "skip", "error")
+               for t in metric_rows):
             return
         ttl = self.admission_batcher.result_cache_ttl_s
         if ttl <= 0:
@@ -684,11 +829,16 @@ class WebhookServer:
             hit = (self._audit_memo.get(memo_key)
                    if memo_key is not None else None)
             if hit is not None and hit[0] > time.monotonic():
-                for pn, rn, sv in hit[1]:
+                for pn, rn, sv, _msg in hit[1]:
                     metrics_mod.record_policy_results(
                         self.registry, pn, rn, sv,
                         validation_mode="audit", resource_kind=kind,
                         request_operation=request.get("operation", "CREATE"))
+                # same reconcile()-during-hit-window gap as the decision
+                # cache: replay the rows so a full rebuild keeps them
+                self._reemit_report_rows(hit[1], resource, request)
+                self.admission_batcher.stats["audit_memo"] = (
+                    self.admission_batcher.stats.get("audit_memo", 0) + 1)
                 return
             # a deadline-free screen must also WAIT deadline-free: with a
             # backed-up link, abandoning at the admission deadline would
@@ -697,11 +847,13 @@ class WebhookServer:
             status, row = self.admission_batcher.screen(
                 PolicyType.VALIDATE_AUDIT, kind, namespace, resource,
                 env=env, deadline_free=True,
-                timeout_s=batch_mod.WEBHOOK_TIMEOUT_S * 6)
+                timeout_s=batch_mod.WEBHOOK_TIMEOUT_S * 6,
+                ctx_cb=lambda: self._admission_ctx_payload(request,
+                                                           namespace))
             if status != batch_mod.ORACLE and row:
                 from ..models import Verdict
 
-                bad = {p for p, _, v in row
+                bad = {p for p, _, v, _ in row
                        if v not in (Verdict.PASS, Verdict.SKIP)}
                 audit_rows = self._record_screen_results(
                     [t for t in row if t[0] not in bad],
@@ -720,7 +872,8 @@ class WebhookServer:
             resp = engine_validate(pctx)
             for rule in resp.policy_response.rules:
                 audit_rows.append(
-                    (policy.name, rule.name, rule.status.value))
+                    (policy.name, rule.name, rule.status.value,
+                     rule.message))
                 metrics_mod.record_policy_results(
                     self.registry, policy.name, rule.name, rule.status.value,
                     validation_mode="audit", resource_kind=kind,
@@ -731,8 +884,8 @@ class WebhookServer:
                 self.report_gen.add(resp)
         if (memo_key is not None and self.admission_batcher is not None
                 and self.admission_batcher.result_cache_ttl_s > 0
-                and all(sv in ("pass", "fail", "skip", "error")
-                        for _, _, sv in audit_rows)):
+                and all(t[2] in ("pass", "fail", "skip", "error")
+                        for t in audit_rows)):
             with self._decision_lock:   # audit workers store concurrently
                 batch_mod.ttl_store(
                     self._audit_memo, memo_key,

@@ -63,7 +63,7 @@ class TestBatcher:
                 pod("nginx:1.21"))
             assert status == CLEAN
             assert row == [("disallow-latest-tag", "validate-image-tag",
-                            Verdict.PASS)]
+                            Verdict.PASS, "")]
         finally:
             batcher.stop()
 
@@ -75,7 +75,7 @@ class TestBatcher:
                 pod("nginx:latest"))
             assert status == ATTENTION
             assert (("disallow-latest-tag", "validate-image-tag",
-                     Verdict.FAIL) in row)
+                     Verdict.FAIL, "") in row)
         finally:
             batcher.stop()
 
@@ -193,7 +193,8 @@ class TestLatencyRouter:
             # simulate a batch already forming for this bucket
             with batcher._lock:
                 bucket = batcher._buckets[key] = _Bucket(cps)
-                bucket.items.append((pod("nginx:1.21", "seed"), Future()))
+                bucket.items.append((pod("nginx:1.21", "seed"), None,
+                                     Future()))
                 batcher._lock.notify()
             status, _ = batcher.screen(
                 PolicyType.VALIDATE_ENFORCE, "Pod", "default",
@@ -281,6 +282,34 @@ class TestWebhookScreenPath:
         finally:
             batcher.stop()
 
+    def test_clean_pod_short_circuits_without_oracle(self):
+        import kyverno_tpu.runtime.webhook as webhook_mod
+
+        server, batcher = self.make_server()
+        ran = []
+        orig_validate = webhook_mod.engine_validate
+
+        def counting(pctx):
+            ran.append(pctx.policy.name)
+            return orig_validate(pctx)
+
+        webhook_mod.engine_validate = counting
+        try:
+            # pre-compile the screen kernel: a cold compile would blow
+            # the screen deadline and (correctly) fall back to the oracle
+            batcher.warmup(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:1.21"))
+            out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                review(pod("nginx:1.21")))
+            assert out["response"]["allowed"] is True
+            # every rule PASSed on device: the decision is CLEAN without
+            # any inline oracle run, and counted as device-decided
+            assert ran == []
+            assert batcher.stats.get("device_decided", 0) == 1
+        finally:
+            webhook_mod.engine_validate = orig_validate
+            batcher.stop()
+
     def test_violating_pod_blocked_with_oracle_message(self):
         server, batcher = self.make_server()
         try:
@@ -347,12 +376,9 @@ class TestWebhookScreenPath:
             webhook_mod.engine_validate = orig_validate
             batcher.stop()
 
-    def test_variable_message_fail_still_runs_oracle(self):
-        # a failing rule whose message needs {{substitution}} cannot be
-        # denied from the device row — the oracle owns the message
-        import kyverno_tpu.runtime.webhook as webhook_mod
-
-        varmsg = {
+    @staticmethod
+    def _varmsg_policy(message):
+        return {
             "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
             "metadata": {"name": "varmsg-latest"},
             "spec": {
@@ -361,16 +387,19 @@ class TestWebhookScreenPath:
                     "name": "no-latest",
                     "match": {"resources": {"kinds": ["Pod"]}},
                     "validate": {
-                        "message":
-                            "{{ request.object.metadata.name }} uses latest",
+                        "message": message,
                         "pattern": {"spec": {"containers": [
                             {"image": "!*:latest"}]}},
                     },
                 }],
             },
         }
+
+    def _deny_with_counting_oracle(self, policy_doc):
+        import kyverno_tpu.runtime.webhook as webhook_mod
+
         cache = PolicyCache()
-        cache.add(load_policy(varmsg))
+        cache.add(load_policy(policy_doc))
         batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
                                    dispatch_cost_init_s=0.0,
                                    oracle_cost_init_s=1.0,
@@ -389,15 +418,34 @@ class TestWebhookScreenPath:
         try:
             out = server.handle(VALIDATING_WEBHOOK_PATH,
                                 review(pod("nginx:latest")))
-            assert out["response"]["allowed"] is False
-            # the oracle ran (for the substituted message)...
-            assert ran == ["varmsg-latest"]
-            # ...and produced the substituted text, not the template
-            msg = out["response"]["status"]["message"]
-            assert "{{" not in msg
+            return out, ran, batcher
         finally:
             webhook_mod.engine_validate = orig_validate
             batcher.stop()
+
+    def test_request_resolvable_variable_message_denies_device_side(self):
+        # a failing rule whose {{variables}} all substitute from the
+        # admission context (request.*, the resource) is denied straight
+        # from the device row with the substituted text — no oracle
+        out, ran, batcher = self._deny_with_counting_oracle(
+            self._varmsg_policy(
+                "{{ request.object.metadata.name }} uses latest"))
+        assert out["response"]["allowed"] is False
+        assert ran == []
+        msg = out["response"]["status"]["message"]
+        assert "{{" not in msg
+        assert "p uses latest" in msg       # substituted, not template
+        assert batcher.stats.get("device_deny", 0) == 1
+
+    def test_cluster_state_variable_message_still_runs_oracle(self):
+        # a message variable the admission context cannot resolve
+        # (cluster state / unknown key) keeps the oracle authoritative
+        out, ran, _ = self._deny_with_counting_oracle(
+            self._varmsg_policy(
+                "{{ request.userInfo.username }} not allowed"))
+        assert out["response"]["allowed"] is False
+        # review() carries no userInfo, so substitution fails -> oracle
+        assert ran == ["varmsg-latest"]
 
     def test_oracle_routed_admission_still_correct(self):
         # production default: lone requests route to the CPU oracle; both
@@ -503,7 +551,7 @@ class TestScreenResultCache:
             s2, row2 = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
                                       "default", pod("nginx:latest"))
             assert batcher.stats.get("cache", 0) == 0   # no stale hit
-            assert {p for p, _, _ in row2} >= {"second"}
+            assert {t[0] for t in row2} >= {"second"}
         finally:
             batcher.stop()
 
@@ -566,6 +614,198 @@ class TestScreenResultCache:
                 out1["response"]["status"]["message"])
         finally:
             webhook_mod.engine_validate = orig_validate
+            batcher.stop()
+
+
+class TestCoalescing:
+    """Cross-request coalescing: concurrently-waiting DISTINCT admissions
+    flush as one padded device batch, and each request's future resolves
+    to ITS OWN verdict row."""
+
+    def test_distinct_concurrent_admissions_share_one_flush(self):
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        # a window long enough that every worker enqueues before the
+        # flush fires — the coalescing claim is exactly "one flush"
+        batcher = AdmissionBatcher(cache, window_s=0.05, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0)
+        try:
+            n = 12
+            pods = [pod("nginx:latest" if i % 3 == 0 else "nginx:1.21",
+                        name=f"pod-{i}") for i in range(n)]
+            # pay the cold XLA compile off the clock, for the EXACT shape
+            # bucket this flush will hit (the dictionary dim depends on
+            # batch content, so warmup with a repeated body compiles a
+            # different bucket): a cold compile can exceed the screen
+            # deadline and timeout the round
+            cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                 "default")
+            warm, _ = batcher._pad_admission(cps.flatten_packed(pods))
+            cps.evaluate_device(warm)
+            evals = []
+            orig = cps.evaluate_device
+            cps.evaluate_device = lambda b: (evals.append(b.n), orig(b))[1]
+            results = [None] * n
+            barrier = threading.Barrier(n)
+
+            def worker(i):
+                barrier.wait()
+                results[i] = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                    pods[i])
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # ONE coalesced device flush for all 12 waiting admissions
+            # (padded up to the admission pad floor)
+            assert evals == [16]
+            for i, (status, row) in enumerate(results):
+                if i % 3 == 0:
+                    assert status == ATTENTION
+                    assert ("disallow-latest-tag", "validate-image-tag",
+                            Verdict.FAIL, "") in row
+                else:
+                    assert status == CLEAN
+                    assert row == [("disallow-latest-tag",
+                                    "validate-image-tag", Verdict.PASS, "")]
+        finally:
+            batcher.stop()
+
+    def test_full_queue_flushes_before_window_elapses(self):
+        # adaptive window: a queue at max_batch must not sit out the
+        # remaining window
+        import time as _t
+
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        batcher = AdmissionBatcher(cache, window_s=1.5, max_batch=8,
+                                   burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0)
+        try:
+            n = 8
+            pods = [pod("nginx:1.21", name=f"pod-{i}") for i in range(n)]
+            # compile the exact flush shape off the clock (see note above)
+            cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                 "default")
+            warm, _ = batcher._pad_admission(cps.flatten_packed(pods))
+            cps.evaluate_device(warm)
+            results = [None] * n
+            barrier = threading.Barrier(n)
+
+            def worker(i):
+                barrier.wait()
+                results[i] = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                    pods[i])
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(n)]
+            t0 = _t.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = _t.monotonic() - t0
+            # the router may divert one request to the oracle lane as a
+            # cost probe; everything screened must come back CLEAN
+            statuses = [s for s, _ in results]
+            assert statuses.count(CLEAN) >= n - 1
+            assert elapsed < 1.0            # did not wait the 1.5s window
+            assert batcher.stats.get("flush_early_full", 0) >= 1
+        finally:
+            batcher.stop()
+
+
+class TestFlushInstrumentation:
+    """Per-flush observability: verdict histogram, per-rule flag counts,
+    escalation reasons — in batcher.stats AND the metrics registry."""
+
+    def test_flush_stats_histogram_and_escalation_reasons(self):
+        batcher, _ = make_batcher()
+        try:
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:1.21"))
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:latest"))
+            cells = batcher.stats.get("flush_cells", {})
+            assert cells.get("PASS", 0) >= 1
+            assert cells.get("FAIL", 0) >= 1
+            assert batcher.stats.get("esc_clean", 0) >= 1
+            assert batcher.stats.get("esc_device_fail", 0) >= 1
+            flagged = batcher.stats.get("flagged_rules", {})
+            assert flagged.get("validate-image-tag", 0) >= 1
+        finally:
+            batcher.stop()
+
+    def test_flush_metrics_recorded_in_registry(self):
+        from kyverno_tpu.runtime import metrics as metrics_mod
+
+        batcher, _ = make_batcher()
+        try:
+            batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           pod("nginx:latest"))
+            exposed = metrics_mod.registry().expose()
+            assert "kyverno_admission_flush_batch_size_count" in exposed
+            assert "kyverno_admission_screen_escalations_total" in exposed
+            assert 'reason="device_fail"' in exposed
+        finally:
+            batcher.stop()
+
+
+class TestDecisionCacheReports:
+    def test_cache_hit_reemits_report_rows_across_reconcile(self):
+        """Regression (round-5 gap): a decision-cache hit skipped report
+        emission, so a reconcile inside the hit window lost the
+        resource's rows until the TTL expired. The hit must re-emit."""
+        from kyverno_tpu.runtime.reports import ReportGenerator
+
+        cache = PolicyCache()
+        cache.add(load_policy(ENFORCE))
+        batcher = AdmissionBatcher(cache, window_s=0.002,
+                                   burst_threshold=100,   # force ORACLE
+                                   result_cache_ttl_s=60.0)
+        reports = ReportGenerator()
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               report_gen=reports,
+                               admission_batcher=batcher)
+        try:
+            out1 = server.handle(VALIDATING_WEBHOOK_PATH,
+                                 review(pod("nginx:latest")))
+            assert out1["response"]["allowed"] is False
+
+            def rows():
+                return {(r["policy"], r["rule"], r["result"],
+                         r.get("message", ""))
+                        for rep in reports.aggregate()
+                        for r in rep.get("results", [])}
+
+            first = rows()
+            assert any(p == "disallow-latest-tag"
+                       and r == "validate-image-tag" and res == "fail"
+                       and "latest tag not allowed" in msg
+                       for p, r, res, msg in first)
+
+            reports.reconcile()             # mid-hit-window rebuild
+            assert rows() == set()          # state really was dropped
+
+            out2 = server.handle(VALIDATING_WEBHOOK_PATH,
+                                 review(pod("nginx:latest")))
+            assert out2["response"]["allowed"] is False
+            assert batcher.stats.get("decision_cache", 0) == 1
+            # the cached decision re-emitted its rows — identical to the
+            # oracle-produced first pass
+            assert rows() == first
+        finally:
             batcher.stop()
 
 

@@ -1,0 +1,99 @@
+"""End-to-end admission smoke: heterogeneous traffic through the webhook.
+
+The round-5 burst numbers turned out to measure the decision cache, not
+the engine (every request carried the same body). This smoke test is the
+standing guard against that regression: 32 DISTINCT admissions through
+the production handler must be decided with (almost) no cache hits and
+with at least one decision settled entirely from the device screen.
+"""
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.batch import AdmissionBatcher
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH, WebhookServer
+
+POLICIES = [
+    {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "disallow-latest-tag"},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }]},
+    },
+    {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "require-name"},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "check-name",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "name required",
+                         "pattern": {"metadata": {"name": "?*"}}},
+        }]},
+    },
+]
+
+
+def _review(i: int) -> dict:
+    """Distinct name, uid, and image per admission — cache-adversarial."""
+    image = "nginx:latest" if i % 4 == 0 else f"nginx:1.{i}"
+    return {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {
+            "uid": f"smoke-uid-{i}", "kind": {"kind": "Pod"},
+            "namespace": "default", "operation": "CREATE",
+            "object": {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"smoke-pod-{i}",
+                             "namespace": "default"},
+                "spec": {"containers": [
+                    {"name": "c", "image": image}]},
+            },
+        },
+    }
+
+
+def test_heterogeneous_admissions_bypass_caches_and_use_device():
+    cache = PolicyCache()
+    for doc in POLICIES:
+        cache.add(load_policy(doc))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               dispatch_cost_init_s=0.0,
+                               oracle_cost_init_s=1.0,
+                               cold_flush_fallback=False,
+                               result_cache_ttl_s=0.0)
+    server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                           admission_batcher=batcher)
+    try:
+        # pre-compile the screen kernel so the first admission doesn't
+        # pay XLA compilation inside its deadline
+        batcher.warmup(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                       _review(0)["request"]["object"])
+        n = 32
+        denied = 0
+        for i in range(n):
+            out = server.handle(VALIDATING_WEBHOOK_PATH, _review(i))
+            allowed = out["response"]["allowed"]
+            assert allowed is (i % 4 != 0)
+            denied += 0 if allowed else 1
+        assert denied == 8
+
+        stats = batcher.stats
+        cache_hits = (stats.get("decision_cache", 0) + stats.get("cache", 0))
+        # heterogeneous traffic must not be answered from caches
+        assert cache_hits < 0.1 * n
+        # and at least one decision must settle entirely on the device
+        # (CLEAN short-circuit or fully device-answered deny)
+        assert stats.get("device_decided", 0) >= 1
+    finally:
+        batcher.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
