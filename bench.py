@@ -352,6 +352,25 @@ def _lane_report(label, lats, burst_s, seq_p50, routing, concurrency):
     }
 
 
+def _decidability_summary(policies) -> dict:
+    """Static per-policy device-decidability (analysis KT110 scores)
+    reported next to the measured routing counters: the analyzer's
+    prediction of how much of the library the device lattice can decide,
+    against which the observed device_decided/host split can be read."""
+    from kyverno_tpu.analysis import analyze_policies
+
+    scores = analyze_policies(policies,
+                              include_tensors=False).device_decidability
+    vals = list(scores.values()) or [1.0]
+    return {
+        "policies": len(scores),
+        "mean": round(sum(vals) / len(vals), 4),
+        "fully_device": sum(1 for v in vals if v == 1.0),
+        "fully_host": sum(1 for v in vals if v == 0.0),
+        "min": round(min(vals), 4),
+    }
+
+
 def bench_config1(jax):
     """disallow-latest-tag x 1 Pod: single-request admission latency through
     the production webhook path over real HTTP. The latency router
@@ -650,6 +669,7 @@ def bench_config1(jax):
                   "req_per_s": round(len(burst_lats) / burst_s),
                   "routing": _counter_delta({}, routing_small)},
         "audit_burst_library_250": audit_burst,
+        "device_decidability_library_250": _decidability_summary(lib),
         "path": "HTTP POST /validate (production handler, latency-routed)",
     }
     out.update(lanes)
@@ -702,6 +722,7 @@ def bench_config2(jax):
         "rules": n_rules,
         "library": LIBRARY_SOURCE.get("best_practices", "reference"),
         "device_rules": int((~cps.tensors.rule_host_only).sum()),
+        "device_decidability": _decidability_summary(cps.policies),
         "device_s_per_batch": round(device_s, 5),
         "flatten_s": round(flatten_s, 3),
         "device_rate": round(validations / device_s),

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# CI lint gate: python hygiene (ruff, when available) + the policy IR
+# static analyzer over the repo's sample policies. Fails on any
+# ERROR-severity diagnostic (see ANALYSIS.md for codes/severities).
+#
+# Usage: deploy/ci_lint.sh [policy-paths...]   (default: tests/policies)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check"
+    ruff check kyverno_tpu tests bench.py || rc=1
+else
+    echo "== ruff not installed; skipping python hygiene pass"
+fi
+
+echo "== analyzer self-smoke (kyverno-tpu lint --self)"
+python -m kyverno_tpu.cli lint --self --fail-on error >/dev/null || rc=1
+
+echo "== policy static analysis (fail on ERROR diagnostics)"
+python -m kyverno_tpu.cli lint --fail-on error "${@:-tests/policies}" || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "ci_lint: FAILED" >&2
+else
+    echo "ci_lint: OK"
+fi
+exit "$rc"

@@ -1,0 +1,36 @@
+"""Static analysis over compiled policy IR and tensors.
+
+Three passes (see ANALYSIS.md for the code catalog):
+
+- escalation provenance (KT1xx): why rules leave the device lattice
+- reachability/conflict (KT2xx): dead rules, shadowed anyPattern
+  branches, constant deny conditions
+- tensor invariants (KT3xx): PolicyTensors / FlatBatch index, dtype,
+  and padding contracts
+
+Entry points: ``analyze_policies`` (policy objects -> AnalysisReport),
+``lint_batch`` (FlatBatch invariants), and the ``kyverno-tpu lint`` CLI.
+"""
+
+from .analyzer import analyze_policies, lint_batch
+from .diagnostics import (
+    CODES,
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+    parse_suppressions,
+)
+from .invariants import check_batch, check_padded, check_tensors
+
+__all__ = [
+    "CODES",
+    "AnalysisReport",
+    "Diagnostic",
+    "Severity",
+    "analyze_policies",
+    "check_batch",
+    "check_padded",
+    "check_tensors",
+    "lint_batch",
+    "parse_suppressions",
+]

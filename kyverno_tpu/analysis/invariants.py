@@ -1,0 +1,185 @@
+"""Tensor-invariant pass (KT3xx).
+
+Validates the structural contracts between the compiler and the device
+kernels: every index tensor in ``PolicyTensors`` stays inside the table
+it gathers from, and every ``FlatBatch`` (raw or bucket-padded) keeps
+the interner/type-tag/padding invariants that ``pack_batch`` and the
+eval kernels assume. A violation here means a malformed gather on
+device — silently wrong verdicts, not an exception — which is why all
+KT3xx diagnostics are ERROR severity.
+
+Pure numpy; no jax import, so the lint CLI stays host-only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.compiler import MAX_SEGMENTS, NFA_STATES, PolicyTensors
+from ..models.flatten import T_ABSENT, T_LIST, FlatBatch
+from ..models.ir import SEP
+from .diagnostics import Diagnostic, make
+
+
+def _bound(name: str, arr, hi: int, lo: int = 0,
+           sentinel: int | None = None) -> list[Diagnostic]:
+    """Index array must lie in [lo, hi) (sentinel value exempt)."""
+    a = np.asarray(arr)
+    if a.size == 0:
+        return []
+    bad = (a < lo) | (a >= hi)
+    if sentinel is not None:
+        bad &= a != sentinel
+    if not bad.any():
+        return []
+    worst = int(a[bad].flat[0])
+    return [make(
+        "KT302",
+        f"{name}: {int(bad.sum())} entries outside [{lo}, {hi}) "
+        f"(first offender {worst}); device gather would read garbage",
+        component=f"tensors.{name}",
+    )]
+
+
+def _dtype(name: str, arr, want: type) -> list[Diagnostic]:
+    a = np.asarray(arr)
+    if np.issubdtype(a.dtype, want):
+        return []
+    return [make(
+        "KT301",
+        f"{name} has dtype {a.dtype}, expected {want.__name__}-like; "
+        "the pjit kernel signature would recompile or miscast",
+        component=f"tensors.{name}",
+    )]
+
+
+def check_tensors(t: PolicyTensors) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    P, C, X = t.n_paths, len(t.chk_op), len(t.ax_op)
+    G, A, GX, FX = t.n_groups, t.n_alts, t.n_aux_groups, t.n_aux_filters
+    N = len(t.nfa_len)
+    R = t.n_rules
+
+    # index-range invariants (KT302)
+    out += _bound("chk_path", t.chk_path, P)
+    out += _bound("chk_rule", t.chk_rule, R)
+    out += _bound("chk_alt_gid", t.chk_alt_gid, A)
+    out += _bound("chk_group_gid", t.chk_group_gid, G)
+    out += _bound("chk_gate", t.chk_gate, t.n_gates, sentinel=-1)
+    out += _bound("chk_nfa", t.chk_nfa, N, sentinel=-1)
+    out += _bound("ax_path", t.ax_path, P, sentinel=-1)
+    out += _bound("ax_rule", t.ax_rule, R)
+    out += _bound("ax_group", t.ax_group, GX)
+    out += _bound("ax_nfa", t.ax_nfa, N, sentinel=-1)
+    out += _bound("ax_kind_req", t.ax_kind_req, len(t.kind_index), sentinel=-1)
+    out += _bound("group_alt", t.group_alt, A)
+    out += _bound("alt_rule", t.alt_rule, R)
+    out += _bound("axg_rule", t.axg_rule, R)
+    out += _bound("axg_filt", t.axg_filt, FX, sentinel=-1)
+    out += _bound("axf_rule", t.axf_rule, R)
+    out += _bound("rule_kind_ids", t.rule_kind_ids, len(t.kind_index),
+                  sentinel=-1)
+
+    # dtype invariants (KT301) on the gather-critical tensors
+    out += _dtype("chk_path", t.chk_path, np.integer)
+    out += _dtype("chk_num_lo", t.chk_num_lo, np.signedinteger)
+    out += _dtype("chk_num_hi", t.chk_num_hi, np.signedinteger)
+    out += _dtype("ax_q_hi", t.ax_q_hi, np.signedinteger)
+    out += _dtype("nfa_char", t.nfa_char, np.unsignedinteger)
+
+    # geometry invariants (KT303)
+    chk_cols = [
+        "chk_path", "chk_op", "chk_rule", "chk_alt_gid", "chk_group_gid",
+        "chk_gate", "chk_guard", "chk_nfa", "chk_num_lo", "chk_num_hi",
+    ]
+    for name in chk_cols:
+        if len(np.asarray(getattr(t, name))) != C:
+            out.append(make(
+                "KT303", f"{name} length {len(np.asarray(getattr(t, name)))} "
+                f"!= check count {C}; check columns desynchronized",
+                component=f"tensors.{name}"))
+    if t.nfa_char.shape[1:] != (NFA_STATES,):
+        out.append(make(
+            "KT303", f"nfa_char state axis {t.nfa_char.shape[1:]} != "
+            f"({NFA_STATES},); glob NFA step would misindex",
+            component="tensors.nfa_char"))
+    if (np.asarray(t.nfa_len) > NFA_STATES - 1).any():
+        out.append(make(
+            "KT303", "nfa_len exceeds NFA_STATES-1; pattern should have "
+            "taken the host lane at compile time",
+            component="tensors.nfa_len"))
+    too_deep = [p for p in t.paths if len(p.split(SEP)) > MAX_SEGMENTS]
+    if too_deep:
+        out.append(make(
+            "KT303", f"{len(too_deep)} dictionary paths exceed "
+            f"MAX_SEGMENTS={MAX_SEGMENTS} (first: "
+            f"{too_deep[0].replace(SEP, '.')!r})",
+            component="tensors.paths"))
+    return out
+
+
+def check_batch(batch: FlatBatch) -> list[Diagnostic]:
+    """FlatBatch invariants the device unpack/gather assumes (KT31x)."""
+    out: list[Diagnostic] = []
+    V = int(batch.str_len.shape[0])
+
+    sid = np.asarray(batch.str_id)
+    bad = (sid < -1) | (sid >= V)
+    if bad.any():
+        out.append(make(
+            "KT311",
+            f"str_id has {int(bad.sum())} entries outside [-1, {V}); the "
+            f"packed word0 gather would read past the dictionary "
+            f"(first offender {int(sid[bad].flat[0])})",
+            component="batch.str_id"))
+
+    tag = np.asarray(batch.type_tag)
+    bad = (tag < T_ABSENT) | (tag > T_LIST)
+    if bad.any():
+        out.append(make(
+            "KT312",
+            f"type_tag has {int(bad.sum())} entries outside "
+            f"[{T_ABSENT}, {T_LIST}]; the 3-bit packed lane would truncate",
+            component="batch.type_tag"))
+
+    # an invalid slot must not claim an interned string: pack_batch scatters
+    # dictionary value lanes from cells, and a stray reference can clobber
+    # a live row's num/dur bits
+    stray = (~np.asarray(batch.slot_valid)) & (sid >= 0) \
+        & (tag != T_ABSENT) & (~np.asarray(batch.null_break))
+    if stray.any():
+        out.append(make(
+            "KT312",
+            f"{int(stray.sum())} invalid slots carry a live str_id; "
+            "dictionary scatter may clobber value lanes",
+            component="batch.slot_valid"))
+
+    if np.asarray(batch.live).shape != (batch.n,):
+        out.append(make(
+            "KT312", f"live mask shape {np.asarray(batch.live).shape} != "
+            f"({batch.n},)", component="batch.live"))
+    return out
+
+
+def check_padded(batch: FlatBatch, orig_n: int) -> list[Diagnostic]:
+    """pad_to_buckets postconditions (KT313): power-of-two axes and dead
+    padding rows."""
+    out: list[Diagnostic] = []
+    for axis, size in (("B", batch.n), ("E", batch.e),
+                       ("V", int(batch.str_len.shape[0]))):
+        if size & (size - 1):
+            out.append(make(
+                "KT313", f"padded axis {axis}={size} is not a power of two; "
+                "the bucket cache would miss every batch",
+                component=f"batch.pad.{axis}"))
+    live = np.asarray(batch.live)
+    if live[orig_n:].any():
+        out.append(make(
+            "KT313", "padding rows past the original batch are marked live; "
+            "they would produce phantom verdicts",
+            component="batch.live"))
+    if np.asarray(batch.slot_valid)[orig_n:].any():
+        out.append(make(
+            "KT313", "padding rows carry valid slots",
+            component="batch.slot_valid"))
+    return out + check_batch(batch)

@@ -31,6 +31,7 @@ from .ir import (
     AuxOp,
     CheckAnchor,
     CheckOp,
+    EscalationReason,
     RuleIR,
     SEP,
     _title_first,
@@ -221,11 +222,13 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
             if len(c.path.split(SEP)) > MAX_SEGMENTS:
                 rule.host_only = True
                 rule.host_reason = "path too deep"
+                rule.host_reason_code = EscalationReason.GEOMETRY.value
                 break
         for a in rule.aux_rows:
             if a.path and len(a.path.split(SEP)) > MAX_SEGMENTS:
                 rule.host_only = True
                 rule.host_reason = "aux path too deep"
+                rule.host_reason_code = EscalationReason.GEOMETRY.value
                 break
 
     chk_cols: dict[str, list] = {k: [] for k in (
@@ -379,6 +382,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         except _Host as e:
             rule.host_only = True
             rule.host_reason = str(e)
+            rule.host_reason_code = EscalationReason.GEOMETRY.value
             continue
 
         # -------- commit the rule

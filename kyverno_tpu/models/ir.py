@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from enum import IntEnum
+from enum import Enum, IntEnum
 from fractions import Fraction
 
 from ..engine.anchors import Anchor, anchor_kind, remove_anchor
@@ -80,8 +80,39 @@ class CheckAnchor(IntEnum):
     ELEMENT_GATE = 4  # per-element condition inside a list
 
 
+class EscalationReason(str, Enum):
+    """Machine-readable taxonomy for why a rule (or one of its checks)
+    escalates to the CPU oracle. Shared by three consumers: the compiler's
+    ``HostOnly`` raises, the static analyzer's KT1xx escalation-provenance
+    diagnostics (kyverno_tpu/analysis), and the runtime escalation metrics
+    (runtime/metrics.py record_host_rule_info) — one vocabulary end to end
+    so a dashboard label and a lint finding always mean the same thing."""
+
+    VARIABLE_REFERENCE = "variable-reference"    # {{var}} / $(ref) operands
+    METACHAR_KEY = "metachar-key"                # wildcard map/label keys
+    UNPARSEABLE_QUANTITY = "unparseable-quantity"  # precision/overflow/form
+    UNSUPPORTED_OPERATOR = "unsupported-operator"  # operator off-lattice
+    ANCHOR_ORDERING = "anchor-ordering"          # order-dependent anchors
+    PATTERN_SHAPE = "pattern-shape"              # structure off the lattice
+    ADMISSION_CONTEXT = "admission-context"      # userinfo / ns selector
+    EXTERNAL_CONTEXT = "external-context"        # context: apiCall/configMap
+    FOREACH = "foreach"                          # foreach validation
+    UNSUPPORTED_CONSTRUCT = "unsupported-construct"  # everything else
+    GEOMETRY = "geometry"                        # tensor limits (depth/NFA)
+
+
 class HostOnly(Exception):
-    """Raised during compilation when a construct needs the CPU oracle."""
+    """Raised during compilation when a construct needs the CPU oracle.
+
+    Carries the human-readable ``detail`` plus a machine-readable
+    ``reason`` (EscalationReason) so the analyzer and runtime metrics
+    never have to parse message strings."""
+
+    def __init__(self, detail: str = "",
+                 reason: "EscalationReason | None" = None):
+        super().__init__(detail)
+        self.detail = detail
+        self.reason = reason or EscalationReason.UNSUPPORTED_CONSTRUCT
 
 
 # ----------------------------------------------------------------- aux rows
@@ -164,17 +195,20 @@ def quantity_to_micro(value) -> int:
     (sub-micro precision or overflow) — those rules take the CPU lane.
     """
     if isinstance(value, bool):
-        raise HostOnly("bool is not numeric")
+        raise HostOnly("bool is not numeric",
+                       EscalationReason.UNPARSEABLE_QUANTITY)
     if isinstance(value, (int, float)):
         frac = Fraction(value).limit_denominator(10**12)
     else:
         frac = parse_quantity(value)
     micro = frac * NUM_SCALE
     if micro.denominator != 1:
-        raise HostOnly(f"sub-micro precision: {value!r}")
+        raise HostOnly(f"sub-micro precision: {value!r}",
+                       EscalationReason.UNPARSEABLE_QUANTITY)
     n = int(micro)
     if abs(n) > NUM_MAX:
-        raise HostOnly(f"quantity overflow: {value!r}")
+        raise HostOnly(f"quantity overflow: {value!r}",
+                       EscalationReason.UNPARSEABLE_QUANTITY)
     return n
 
 
@@ -223,7 +257,8 @@ class RuleIR:
     n_alts: int = 1
     n_gates: int = 0
     host_only: bool = False
-    host_reason: str = ""
+    host_reason: str = ""            # human-readable detail
+    host_reason_code: str = ""       # EscalationReason value ("" = device)
     # gate group -> array-prefix path (for element alignment validation)
     gate_prefix: dict[int, str] = field(default_factory=dict)
     # aux program (match/exclude filters + precondition/deny conditions)
@@ -267,7 +302,8 @@ class _PatternCompiler:
 
     def compile(self, pattern) -> None:
         if not isinstance(pattern, dict):
-            raise HostOnly("top-level pattern must be a map")
+            raise HostOnly("top-level pattern must be a map",
+                           EscalationReason.PATTERN_SHAPE)
         self._walk_map(pattern, "", gate=-1, array_depth=0, guard=0)
 
     # ---------------------------------------------------------------- walk
@@ -290,20 +326,24 @@ class _PatternCompiler:
         if (len(kinds_here) > 1
                 and any(k in (Anchor.CONDITION, Anchor.GLOBAL)
                         for k in kinds_here)):
-            raise HostOnly("skip-capable anchor sharing a map level")
+            raise HostOnly("skip-capable anchor sharing a map level",
+                           EscalationReason.ANCHOR_ORDERING)
         for key, value in pattern.items():
             kind = anchor_kind(key)
             bare, _ = remove_anchor(key)
             if "*" in bare or "?" in bare:
                 # wildcard map keys expand against the resource at match time
                 # (wildcards.ExpandInMetadata) - host lane
-                raise HostOnly("wildcard map key")
+                raise HostOnly("wildcard map key",
+                               EscalationReason.METACHAR_KEY)
             child_path = f"{path}{SEP}{bare}" if path else bare
 
             if kind in (Anchor.CONDITION, Anchor.GLOBAL):
                 if array_depth > 0:
                     # handled by _walk_list via element gates
-                    raise HostOnly("conditional anchor below an array outside a gated element")
+                    raise HostOnly(
+                        "conditional anchor below an array outside a gated element",
+                        EscalationReason.ANCHOR_ORDERING)
                 anchor = (
                     CheckAnchor.CONDITION if kind is Anchor.CONDITION else CheckAnchor.GLOBAL
                 )
@@ -321,10 +361,12 @@ class _PatternCompiler:
                                    guard_mask=guard))
             elif kind is Anchor.EXISTENCE:
                 if array_depth > 0:
-                    raise HostOnly("existence anchor inside an array")
+                    raise HostOnly("existence anchor inside an array",
+                                   EscalationReason.PATTERN_SHAPE)
                 self._walk_existence(value, child_path, guard)
             elif kind is Anchor.ADD_IF_NOT_PRESENT:
-                raise HostOnly("+() anchor is mutate-only")
+                raise HostOnly("+() anchor is mutate-only",
+                               EscalationReason.UNSUPPORTED_CONSTRUCT)
             elif value == "*":
                 # DefaultHandler's special case (anchor/anchor.go:118):
                 # a plain map key with pattern "*" means "present and
@@ -348,14 +390,16 @@ class _PatternCompiler:
                 # condition predicate subtree: leaves inherit the anchor
                 for k, v in value.items():
                     if anchor_kind(k) is not Anchor.NONE:
-                        raise HostOnly("nested anchor inside condition subtree")
+                        raise HostOnly("nested anchor inside condition subtree",
+                                       EscalationReason.ANCHOR_ORDERING)
                     self._compile_subtree(v, f"{path}{SEP}{k}", anchor, gate,
                                           array_depth, guard, cond_depth)
                 return
             self._walk_map(value, path, gate, array_depth, guard)
         elif isinstance(value, list):
             if anchor in (CheckAnchor.CONDITION, CheckAnchor.GLOBAL):
-                raise HostOnly("array inside condition predicate")
+                raise HostOnly("array inside condition predicate",
+                               EscalationReason.PATTERN_SHAPE)
             self._walk_list(value, path, anchor, array_depth, guard)
         else:
             if anchor is CheckAnchor.EQUALITY:
@@ -368,21 +412,24 @@ class _PatternCompiler:
         """validate.go:140 validateArray: a single pattern element applies to
         every resource element."""
         if len(pattern) != 1:
-            raise HostOnly("multi-element pattern arrays")
+            raise HostOnly("multi-element pattern arrays",
+                           EscalationReason.PATTERN_SHAPE)
         element = pattern[0]
         elem_path = f"{path}{SEP}*"
         if isinstance(element, dict):
             gates = [k for k in element if anchor_kind(k) in (Anchor.CONDITION, Anchor.GLOBAL)]
             if gates:
                 if array_depth > 0:
-                    raise HostOnly("element gates in nested arrays")
+                    raise HostOnly("element gates in nested arrays",
+                                   EscalationReason.PATTERN_SHAPE)
                 if any(anchor_kind(k) is Anchor.GLOBAL for k in gates):
                     # <() in an array element is NOT an element filter: a
                     # predicate mismatch on any element skips the whole
                     # RULE (GlobalConditionError propagates out of
                     # validateArrayOfMaps), an order-dependent semantic
                     # the gate lattice cannot express — oracle decides
-                    raise HostOnly("global anchor in array element")
+                    raise HostOnly("global anchor in array element",
+                                   EscalationReason.ANCHOR_ORDERING)
                 rest = {k: v for k, v in element.items() if k not in gates}
                 if not rest:
                     # pure-filter element ({(cond): pat} and nothing
@@ -410,14 +457,16 @@ class _PatternCompiler:
                 self._compile_subtree(element, elem_path, anchor, -1,
                                       array_depth + 1, guard)
         elif isinstance(element, list):
-            raise HostOnly("array of arrays pattern")
+            raise HostOnly("array of arrays pattern",
+                           EscalationReason.PATTERN_SHAPE)
         else:
             self._emit_leaf(element, elem_path, anchor, -1, guard=guard)
 
     def _compile_gate_predicate(self, value, path: str, gate_id: int) -> None:
         """The anchored key's pattern becomes the gate predicate rows."""
         if isinstance(value, (dict, list)):
-            raise HostOnly("non-scalar element gate predicate")
+            raise HostOnly("non-scalar element gate predicate",
+                           EscalationReason.PATTERN_SHAPE)
         self._emit_leaf(value, path, CheckAnchor.ELEMENT_GATE, gate_id)
 
     def _walk_existence(self, value, path: str, guard: int = 0) -> None:
@@ -427,16 +476,19 @@ class _PatternCompiler:
         equality-anchor bits from ancestors: an absent =() key makes the
         existence check vacuous too."""
         if not isinstance(value, list) or len(value) != 1:
-            raise HostOnly("existence anchor expects a single-element list")
+            raise HostOnly("existence anchor expects a single-element list",
+                           EscalationReason.PATTERN_SHAPE)
         element = value[0]
         elem_path = f"{path}{SEP}*"
         group = self.next_group()
         if isinstance(element, dict):
             if len(element) != 1:
-                raise HostOnly("existence anchor over multi-key element")
+                raise HostOnly("existence anchor over multi-key element",
+                               EscalationReason.PATTERN_SHAPE)
             for k, v in element.items():
                 if anchor_kind(k) is not Anchor.NONE or isinstance(v, (dict, list)):
-                    raise HostOnly("nested existence anchor")
+                    raise HostOnly("nested existence anchor",
+                                   EscalationReason.PATTERN_SHAPE)
                 self._emit_leaf(
                     v, f"{elem_path}{SEP}{k}", CheckAnchor.NONE, -1,
                     existence_group=group, guard=guard,
@@ -461,7 +513,8 @@ class _PatternCompiler:
                 and ("&" in value or "|" in value)):
             # the at-least-one-element OR and the compound split cannot
             # share the two-level group lattice
-            raise HostOnly("compound pattern under existence anchor")
+            raise HostOnly("compound pattern under existence anchor",
+                           EscalationReason.PATTERN_SHAPE)
         group = existence_group if existence_group is not None else self.next_group()
         existence = existence_group is not None
 
@@ -485,12 +538,14 @@ class _PatternCompiler:
                          existence)
             return
         if not isinstance(value, str):
-            raise HostOnly(f"unsupported leaf pattern type {type(value).__name__}")
+            raise HostOnly(f"unsupported leaf pattern type {type(value).__name__}",
+                           EscalationReason.PATTERN_SHAPE)
 
         if "&" in value and "|" in value:
             # mixed compound: (a AND b) OR c — an OR of ANDs the two-level
             # group lattice (rows OR in group, groups AND) cannot express
-            raise HostOnly("mixed &/| compound pattern")
+            raise HostOnly("mixed &/| compound pattern",
+                           EscalationReason.PATTERN_SHAPE)
         if "&" in value:
             # AND-compound: each part its own group (pattern.go:165)
             for part in value.split("&"):
@@ -521,7 +576,8 @@ class _PatternCompiler:
                 # operator is constant false (pattern.go:173) — host keeps
                 # the anchor skip/fail lattice exact for this odd case
                 raise HostOnly(f"comparison operand without number part: "
-                               f"{pattern!r}")
+                               f"{pattern!r}",
+                               EscalationReason.UNSUPPORTED_OPERATOR)
             try:
                 n = quantity_to_micro(operand)
             except QuantityError:
@@ -530,7 +586,8 @@ class _PatternCompiler:
                 # fixed-point "%f" floats, nil -> "0" — a stringification
                 # the device dictionary does not carry (pattern.go:283-288)
                 raise HostOnly(
-                    f"number-part operand without quantity form: {operand!r}")
+                    f"number-part operand without quantity form: {operand!r}",
+                    EscalationReason.UNPARSEABLE_QUANTITY)
             num_op = {
                 Op.MORE: CheckOp.NUM_GT,
                 Op.MORE_EQUAL: CheckOp.NUM_GE,
@@ -561,7 +618,8 @@ class _PatternCompiler:
                 # (pattern.go:283, operator ignored) -> host lane, like the
                 # comparison-op branch above
                 raise HostOnly(
-                    f"number-part operand without quantity form: {operand!r}")
+                    f"number-part operand without quantity form: {operand!r}",
+                    EscalationReason.UNPARSEABLE_QUANTITY)
             check = CheckIR(
                 path=path,
                 op=CheckOp.STR_NE if negate else CheckOp.STR_EQ,
@@ -661,10 +719,12 @@ def _compile_filter(b: _AuxBuilder, rf, klass: int, fi: int,
         # roles/clusterRoles/subjects need live admission context; in a
         # batched scan the oracle result also differs from admission — the
         # whole rule takes the host lane (utils.go:196-234)
-        raise HostOnly("userinfo in match/exclude")
+        raise HostOnly("userinfo in match/exclude",
+                       EscalationReason.ADMISSION_CONTEXT)
     desc = rf.resources
     if desc.namespace_selector is not None:
-        raise HostOnly("namespaceSelector needs namespace labels")
+        raise HostOnly("namespaceSelector needs namespace labels",
+                       EscalationReason.ADMISSION_CONTEXT)
     if desc.is_empty():
         if klass == AUX_MATCH:
             # "match cannot be empty" -> filter never matches
@@ -694,7 +754,8 @@ def _compile_filter(b: _AuxBuilder, rf, klass: int, fi: int,
                 b.row(klass, AuxOp.GLOB, g, filt=fi, kind_req=kind,
                       path="apiVersion", pattern=f"{parts[0]}/{version}")
             else:
-                raise HostOnly(f"unparseable kind {entry!r}")
+                raise HostOnly(f"unparseable kind {entry!r}",
+                               EscalationReason.UNSUPPORTED_CONSTRUCT)
 
     name_patterns = ([desc.name] if desc.name else []) + list(desc.names or [])
     if desc.name and desc.names:
@@ -717,7 +778,8 @@ def _compile_filter(b: _AuxBuilder, rf, klass: int, fi: int,
 
     for k, v in (desc.annotations or {}).items():
         if "*" in k or "?" in k:
-            raise HostOnly("wildcard annotation key in match")
+            raise HostOnly("wildcard annotation key in match",
+                           EscalationReason.METACHAR_KEY)
         g = b.new_group()
         b.row(klass, AuxOp.GLOB, g, filt=fi,
               path=f"metadata{SEP}annotations{SEP}{k}", pattern=str(v))
@@ -739,14 +801,16 @@ def _compile_selector(b: _AuxBuilder, selector: dict, klass: int, fi: int) -> No
     glob row reproduces; wildcard *keys* need dynamic expansion -> host."""
     for k, v in (selector.get("matchLabels") or {}).items():
         if "*" in k or "?" in k:
-            raise HostOnly("wildcard label key in selector")
+            raise HostOnly("wildcard label key in selector",
+                           EscalationReason.METACHAR_KEY)
         g = b.new_group()
         b.row(klass, AuxOp.GLOB, g, filt=fi,
               path=f"metadata{SEP}labels{SEP}{k}", pattern=str(v))
     for expr in selector.get("matchExpressions") or []:
         k = expr.get("key", "")
         if "*" in k or "?" in k:
-            raise HostOnly("wildcard label key in matchExpressions")
+            raise HostOnly("wildcard label key in matchExpressions",
+                           EscalationReason.METACHAR_KEY)
         op = (expr.get("operator") or "").lower()
         values = [str(x) for x in (expr.get("values") or [])]
         path = f"metadata{SEP}labels{SEP}{k}"
@@ -768,7 +832,8 @@ def _compile_selector(b: _AuxBuilder, selector: dict, klass: int, fi: int) -> No
             b.row(klass, AuxOp.NOT_EXISTS, g, filt=fi, path=path,
                   absent_res=True)
         else:
-            raise HostOnly(f"selector operator {op!r}")
+            raise HostOnly(f"selector operator {op!r}",
+                           EscalationReason.UNSUPPORTED_OPERATOR)
 
 
 # ----------------------------------------------------- condition compilation
@@ -831,7 +896,8 @@ def compile_conditions(raw, klass: int, ir: RuleIR) -> None:
     b = _AuxBuilder(ir)
     if isinstance(raw, dict):
         if not set(raw) <= {"any", "all"}:
-            raise HostOnly("invalid conditions block")
+            raise HostOnly("invalid conditions block",
+                           EscalationReason.PATTERN_SHAPE)
         any_conds = raw.get("any") or []
         all_conds = raw.get("all") or []
         # a PRESENT-but-empty any-list still fails the block: evaluate.go
@@ -840,7 +906,7 @@ def compile_conditions(raw, klass: int, ir: RuleIR) -> None:
     elif isinstance(raw, list):
         any_conds, all_conds, has_any = [], raw, False
     else:
-        raise HostOnly("invalid conditions")
+        raise HostOnly("invalid conditions", EscalationReason.PATTERN_SHAPE)
     if klass == AUX_PRECOND:
         ir.has_precond = True
         ir.precond_has_any = has_any
@@ -871,7 +937,8 @@ def _operand_flags(value) -> dict:
         kw["o_is_num"] = True
         m = _static_quant_micro(value)
         if m is None:
-            raise HostOnly(f"operand precision: {value!r}")
+            raise HostOnly(f"operand precision: {value!r}",
+                           EscalationReason.UNPARSEABLE_QUANTITY)
         kw["o_qmicro"] = m
         kw["o_smicro"] = m  # numeric operand doubles as seconds
         kw["o_is_quant"] = True
@@ -890,7 +957,8 @@ def _operand_flags(value) -> dict:
             if not kw.get("o_is_dur_any"):
                 m = _static_quant_micro(value)
                 if m is None:
-                    raise HostOnly(f"operand precision: {value!r}")
+                    raise HostOnly(f"operand precision: {value!r}",
+                                   EscalationReason.UNPARSEABLE_QUANTITY)
                 kw["o_smicro"] = m
         except ValueError:
             pass
@@ -904,7 +972,8 @@ def _operand_flags(value) -> dict:
             kw["o_qmicro"] = m
             kw["o_is_quant"] = True
     else:
-        raise HostOnly("non-scalar condition operand")
+        raise HostOnly("non-scalar condition operand",
+                       EscalationReason.PATTERN_SHAPE)
     return kw
 
 
@@ -920,7 +989,8 @@ def _compile_condition(b: _AuxBuilder, cond: dict, klass: int,
         return _contains_variable(x)
 
     if has_var(value):
-        raise HostOnly("variables in condition value")
+        raise HostOnly("variables in condition value",
+                       EscalationReason.VARIABLE_REFERENCE)
 
     err_absent = klass == AUX_DENY  # deny substitution errors on unresolved
 
@@ -933,10 +1003,12 @@ def _compile_condition(b: _AuxBuilder, cond: dict, klass: int,
 
     segs = _parse_condition_key(key)
     if segs is None:
-        raise HostOnly(f"condition key not compilable: {key!r}")
+        raise HostOnly(f"condition key not compilable: {key!r}",
+                       EscalationReason.VARIABLE_REFERENCE)
     path = SEP.join(segs)
     if "*" in segs:
-        raise HostOnly("wildcard in condition key path")
+        raise HostOnly("wildcard in condition key path",
+                       EscalationReason.METACHAR_KEY)
     g = b.new_group()
     common = dict(path=path, any_block=any_block, err_on_absent=err_absent,
                   filt=0)
@@ -1113,18 +1185,19 @@ def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
         namespaces=list(rule.match.resources.namespaces),
     )
 
-    def host(reason: str) -> RuleIR:
+    def host(reason: str, code: EscalationReason) -> RuleIR:
         ir.host_only = True
         ir.host_reason = reason
+        ir.host_reason_code = code.value
         ir.checks = []
         ir.aux_rows = []
         return ir
 
     v = rule.validation
     if v.foreach:
-        return host("foreach rules")
+        return host("foreach rules", EscalationReason.FOREACH)
     if rule.context:
-        return host("external context")
+        return host("external context", EscalationReason.EXTERNAL_CONTEXT)
 
     try:
         compile_match_program(rule, getattr(policy, "namespace", ""), ir)
@@ -1135,7 +1208,8 @@ def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
             ir.is_deny = True
             conditions = (v.deny or {}).get("conditions")
             if conditions is None:
-                return host("deny without conditions")
+                return host("deny without conditions",
+                            EscalationReason.UNSUPPORTED_CONSTRUCT)
             compile_conditions(conditions, AUX_DENY, ir)
             ir.n_alts = 0
             return ir
@@ -1143,20 +1217,25 @@ def compile_rule_ir(policy, rule, rule_index: int) -> RuleIR:
         patterns = []
         if v.pattern is not None:
             if _contains_variable(v.pattern):
-                return host("variables in pattern")
+                return host("variables in pattern",
+                            EscalationReason.VARIABLE_REFERENCE)
             patterns = [v.pattern]
         elif v.any_pattern is not None:
             if not isinstance(v.any_pattern, list):
-                return host("malformed anyPattern")
+                return host("malformed anyPattern",
+                            EscalationReason.PATTERN_SHAPE)
             if _contains_variable(v.any_pattern):
-                return host("variables in anyPattern")
+                return host("variables in anyPattern",
+                            EscalationReason.VARIABLE_REFERENCE)
             patterns = v.any_pattern
         else:
-            return host("no pattern")
+            return host("no pattern", EscalationReason.UNSUPPORTED_CONSTRUCT)
 
         ir.n_alts = len(patterns)
         for alt, pattern in enumerate(patterns):
             _PatternCompiler(ir, alt).compile(pattern)
-    except (HostOnly, QuantityError) as e:
-        return host(str(e))
+    except HostOnly as e:
+        return host(e.detail or str(e), e.reason)
+    except QuantityError as e:
+        return host(str(e), EscalationReason.UNPARSEABLE_QUANTITY)
     return ir

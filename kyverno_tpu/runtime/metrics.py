@@ -161,6 +161,28 @@ def record_flush_batch(registry: MetricsRegistry, size: int,
                              {}, float(host_resolved))
 
 
+def record_device_decidability(registry: MetricsRegistry, policy: str,
+                               score: float) -> None:
+    """Fraction of a policy's validate rules that compile to the device
+    lattice (0.0 = pure CPU-oracle policy, 1.0 = fully device-decided).
+    Set by the static analyzer at policy-cache admission and surfaced by
+    bench.py next to the routing counters; a drop after a policy edit
+    means the edit silently widened the host fallback."""
+    registry.set_gauge("kyverno_policy_device_decidability",
+                       {"policy_name": policy}, score)
+
+
+def record_host_rule_info(registry: MetricsRegistry, policy: str, rule: str,
+                          reason: str) -> None:
+    """One gauge row per host-only rule, labelled with the
+    ``EscalationReason`` value (models/ir.py) — the same taxonomy the
+    KT101 lint diagnostic reports, so dashboards and lint output agree
+    on why a rule escalates."""
+    registry.set_gauge("kyverno_policy_host_rule_info", {
+        "policy_name": policy, "rule_name": rule, "reason": reason,
+    }, 1.0)
+
+
 def record_screen_escalation(registry: MetricsRegistry, reason: str,
                              value: float = 1.0) -> None:
     """Why a screened admission row escalated past CLEAN — the routing

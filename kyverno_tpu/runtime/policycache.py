@@ -10,10 +10,22 @@ star (BASELINE.json).
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 from enum import IntFlag
 
 from ..api.types import ClusterPolicy
+
+logger = logging.getLogger(__name__)
+
+# Warn-only admission lint: every policy entering the cache runs through
+# the static analyzer (kyverno_tpu/analysis). Diagnostics are logged and
+# kept on the cache for inspection — a broken policy is still admitted
+# (Kyverno semantics: the API server accepted it; refusing here would
+# silently drop enforcement). Disable via env for perf-sensitive tests.
+LINT_ON_ADMISSION = os.environ.get(
+    "KYVERNO_TPU_LINT_ON_ADMISSION", "1") not in ("0", "false", "")
 
 
 class PolicyType(IntFlag):
@@ -46,6 +58,8 @@ class PolicyCache:
         self._compiled = {}
         self._generation = 0
         self._listeners: list = []
+        # policy key -> AnalysisReport from the warn-only admission lint
+        self.lint_reports: dict[str, object] = {}
 
     def add_listener(self, fn) -> None:
         """fn(event, policy) fires after add/update ("SET") and remove
@@ -94,7 +108,35 @@ class PolicyCache:
                         ).append(key)
             self._generation += 1
             self._compiled.clear()
+        if LINT_ON_ADMISSION:
+            self._lint_admitted(key, policy)
         self._fire("SET", policy)
+
+    def _lint_admitted(self, key: str, policy: ClusterPolicy) -> None:
+        """Warn-only static analysis of a just-admitted policy. Never
+        blocks or raises: the cache must keep serving lookups even if the
+        analyzer trips on an exotic policy."""
+        try:
+            from ..models.ir import EscalationReason
+            from .metrics import (record_device_decidability,
+                                  record_host_rule_info, registry)
+            from ..analysis import Severity, analyze_policies
+
+            report = analyze_policies([policy], include_tensors=False)
+            self.lint_reports[key] = report
+            for d in report.diagnostics:
+                if d.severity >= Severity.WARNING:
+                    logger.warning("policy lint: %s", d.format())
+                if d.code == "KT101":
+                    record_host_rule_info(
+                        registry(), d.policy, d.rule,
+                        d.reason or EscalationReason.UNSUPPORTED_CONSTRUCT.value)
+            score = report.device_decidability.get(policy.name)
+            if score is not None:
+                record_device_decidability(registry(), policy.name, score)
+        except Exception:
+            logger.exception("policy lint failed for %s (policy admitted)",
+                             key)
 
     def remove(self, policy: ClusterPolicy) -> None:
         with self._lock:
@@ -108,6 +150,7 @@ class PolicyCache:
 
     def _remove_locked(self, key: str) -> None:
         self._policies.pop(key, None)
+        self.lint_reports.pop(key, None)
         for type_map in self._kind_map.values():
             for ptype in list(type_map):
                 type_map[ptype] = [k for k in type_map[ptype] if k != key]

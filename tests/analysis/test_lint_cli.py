@@ -1,0 +1,133 @@
+"""lint CLI + ci gate + admission-hook integration.
+
+Subprocess tests pin JAX_PLATFORMS=cpu out of caution, but the lint
+path must never import jax at all — asserted explicitly below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEAD_POLICY = """\
+apiVersion: kyverno.io/v1
+kind: ClusterPolicy
+metadata:
+  name: injected-dead
+spec:
+  rules:
+    - name: unreachable
+      match:
+        any:
+          - {}
+      validate:
+        pattern:
+          metadata:
+            name: "?*"
+"""
+
+
+def _run(*argv, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(list(argv), cwd=REPO, env=env, text=True,
+                          capture_output=True, timeout=120, **kw)
+
+
+def test_lint_self_smoke_exits_clean():
+    r = _run(sys.executable, "-m", "kyverno_tpu.cli", "lint", "--self")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "KT110" in r.stdout
+
+
+def test_lint_sample_policies_emits_four_categories():
+    """Acceptance criterion: >= 4 distinct stable codes on the seed
+    sample policies."""
+    r = _run(sys.executable, "-m", "kyverno_tpu.cli", "lint", "--json",
+             "tests/policies")
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    cats = set(report["summary"]["categories"])
+    assert {"KT101", "KT110", "KT202", "KT203"} <= cats
+    assert len(cats) >= 4
+
+
+def test_lint_fail_on_error_flips_exit_code(tmp_path):
+    bad = tmp_path / "dead.yaml"
+    bad.write_text(DEAD_POLICY)
+    r = _run(sys.executable, "-m", "kyverno_tpu.cli", "lint", str(bad))
+    assert r.returncode == 1
+    assert "KT201" in r.stdout
+    r = _run(sys.executable, "-m", "kyverno_tpu.cli", "lint",
+             "--fail-on", "never", str(bad))
+    assert r.returncode == 0
+
+
+def test_lint_suppress_flag_drops_codes():
+    r = _run(sys.executable, "-m", "kyverno_tpu.cli", "lint",
+             "--suppress", "KT101,KT110,KT202,KT203", "tests/policies")
+    assert r.returncode == 0
+    assert "KT101" not in r.stdout and "KT202" not in r.stdout
+
+
+def test_ci_lint_script_gates_on_injected_error(tmp_path):
+    """Acceptance criterion: deploy/ci_lint.sh exits non-zero when an
+    ERROR diagnostic is injected, zero on the shipped samples."""
+    clean = _run("bash", "deploy/ci_lint.sh")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    bad = tmp_path / "dead.yaml"
+    bad.write_text(DEAD_POLICY)
+    injected = _run("bash", "deploy/ci_lint.sh", str(bad))
+    assert injected.returncode != 0
+    assert "KT201" in injected.stdout
+
+
+def test_lint_path_never_imports_jax():
+    code = ("import sys; import kyverno_tpu.cli.lint_cmd, "
+            "kyverno_tpu.analysis; sys.exit(1 if 'jax' in sys.modules "
+            "else 0)")
+    r = _run(sys.executable, "-c", code)
+    assert r.returncode == 0, "lint path imported jax"
+
+
+def test_policycache_admission_lint_warn_only():
+    """A policy with an ERROR diagnostic is still admitted (warn-only),
+    the report lands on the cache, and the gauges are recorded."""
+    import yaml
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime.metrics import registry
+    from kyverno_tpu.runtime import policycache
+    from kyverno_tpu.runtime.policycache import PolicyCache
+
+    if not policycache.LINT_ON_ADMISSION:
+        pytest.skip("admission lint disabled via env")
+
+    cache = PolicyCache()
+    dead = load_policy(yaml.safe_load(DEAD_POLICY))
+    host = load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "host-var"},
+        "spec": {"rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"pattern": {"metadata": {
+                "name": "{{request.object.spec.x}}"}}},
+        }]},
+    })
+    cache.add(dead)
+    cache.add(host)
+
+    assert "injected-dead" in cache.lint_reports       # admitted anyway
+    codes = {d.code for d in cache.lint_reports["injected-dead"].diagnostics}
+    assert "KT201" in codes
+    exposed = registry().expose()
+    assert ('kyverno_policy_device_decidability{policy_name="host-var"} 0'
+            in exposed)
+    assert 'reason="variable-reference"' in exposed
+
+    cache.remove(host)
+    assert "host-var" not in cache.lint_reports

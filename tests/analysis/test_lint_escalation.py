@@ -1,0 +1,101 @@
+"""Golden diagnostics: escalation-provenance pass (KT1xx)."""
+
+from kyverno_tpu.analysis import Severity, analyze_policies
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models.ir import EscalationReason
+
+
+def _policy(name, rules):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": {"rules": rules},
+    })
+
+
+def _rule(name, validate, match=None, **extra):
+    r = {"name": name,
+         "match": match or {"resources": {"kinds": ["Pod"]}},
+         "validate": validate}
+    r.update(extra)
+    return r
+
+
+def _find(report, code):
+    return [d for d in report.diagnostics if d.code == code]
+
+
+def test_variable_forced_host_check_golden():
+    """A {{request...}} variable in the pattern escalates with the exact
+    machine-readable reason, pinned to the pattern component."""
+    p = _policy("var-host", [_rule("label-eq-name", {
+        "pattern": {"metadata": {"labels": {
+            "app": "{{request.object.metadata.name}}"}}}})])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT101")
+    assert d.severity is Severity.INFO
+    assert d.policy == "var-host"
+    assert d.rule == "label-eq-name"
+    assert d.component == "pattern"
+    assert d.reason == EscalationReason.VARIABLE_REFERENCE.value
+    assert report.device_decidability["var-host"] == 0.0
+
+
+def test_escalation_reason_taxonomy_is_shared():
+    """Each escalating construct maps to its EscalationReason value —
+    the same strings record_host_rule_info exports as metric labels."""
+    cases = [
+        # (rule dict, expected reason, expected component)
+        (_rule("foreach", {"foreach": [{"list": "request.object.spec.containers",
+                                        "pattern": {"image": "*:*"}}]}),
+         EscalationReason.FOREACH.value, "validate.foreach"),
+        (_rule("ctx", {"pattern": {"metadata": {"name": "?*"}}},
+               context=[{"name": "cm", "configMap": {"name": "x"}}]),
+         EscalationReason.EXTERNAL_CONTEXT.value, "context"),
+        (_rule("userinfo", {"pattern": {"metadata": {"name": "?*"}}},
+               match={"resources": {"kinds": ["Pod"]},
+                      "clusterRoles": ["admin"]}),
+         EscalationReason.ADMISSION_CONTEXT.value, "match"),
+        (_rule("wildkey", {"pattern": {"metadata": {"name": "?*"}}},
+               match={"resources": {"kinds": ["Pod"],
+                                    "selector": {"matchLabels": {"a*": "b"}}}}),
+         EscalationReason.METACHAR_KEY.value, "match"),
+        (_rule("badquant", {"pattern": {"spec": {"replicas": "<1e40Gi"}}}),
+         EscalationReason.UNPARSEABLE_QUANTITY.value, "pattern"),
+    ]
+    for rule, reason, component in cases:
+        p = _policy(f"tax-{rule['name']}", [rule])
+        report = analyze_policies([p])
+        (d,) = _find(report, "KT101")
+        assert d.reason == reason, (rule["name"], d.reason)
+        assert d.component == component, (rule["name"], d.component)
+
+
+def test_fully_host_policy_warns_kt102():
+    p = _policy("all-host", [_rule("r1", {
+        "pattern": {"metadata": {"name": "{{request.object.spec.x}}"}}})])
+    report = analyze_policies([p])
+    assert _find(report, "KT102")
+    assert report.device_decidability["all-host"] == 0.0
+
+
+def test_decidability_score_kt110_always_emitted():
+    p = _policy("half", [
+        _rule("dev", {"pattern": {"metadata": {"name": "?*"}}}),
+        _rule("host", {"pattern": {"metadata": {
+            "name": "{{request.object.spec.x}}"}}}),
+    ])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT110")
+    assert "0.50" in d.message
+    assert report.device_decidability["half"] == 0.5
+
+
+def test_host_only_rule_ir_carries_reason_code():
+    """The compiler itself (not just the analyzer) stamps the enum value."""
+    from kyverno_tpu.models.ir import compile_rule_ir
+
+    p = _policy("stamp", [_rule("r", {
+        "pattern": {"metadata": {"name": "{{request.object.spec.x}}"}}})])
+    ir = compile_rule_ir(p, p.spec.rules[0], 0)
+    assert ir.host_only
+    assert ir.host_reason_code == EscalationReason.VARIABLE_REFERENCE.value

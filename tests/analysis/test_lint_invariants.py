@@ -1,0 +1,117 @@
+"""Golden diagnostics: tensor/batch invariant pass (KT3xx).
+
+Corruptions are injected into otherwise-valid compiled artifacts, so
+each test proves both directions: the clean artifact is silent and the
+specific mutilation trips the specific code.
+"""
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.analysis import check_batch, check_padded, check_tensors
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models.compiler import compile_tensors
+from kyverno_tpu.models.flatten import flatten_batch, pad_to_buckets
+from kyverno_tpu.models.ir import compile_rule_ir
+
+
+@pytest.fixture()
+def compiled():
+    p = load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "inv"},
+        "spec": {"rules": [{
+            "name": "r",
+            "match": {"resources": {"kinds": ["Pod"],
+                                    "namespaces": ["prod-*"]}},
+            "validate": {"pattern": {"spec": {
+                "containers": [{"image": "!*:latest"}],
+                "replicas": ">0"}}},
+        }]},
+    })
+    return compile_tensors([compile_rule_ir(p, p.spec.rules[0], 0)])
+
+
+@pytest.fixture()
+def batch(compiled):
+    resources = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a", "namespace": "prod-1"},
+         "spec": {"containers": [{"image": "nginx:1.27"}], "replicas": 2}},
+        {"kind": "Pod", "metadata": {"name": "b"},
+         "spec": {"containers": [{"image": "nginx:latest"},
+                                 {"image": "busybox"}]}},
+    ]
+    return flatten_batch(resources, compiled)
+
+
+def _codes(diags):
+    return {d.code for d in diags}
+
+
+def test_clean_tensors_and_batch_are_silent(compiled, batch):
+    assert check_tensors(compiled) == []
+    assert check_batch(batch) == []
+    padded, n = pad_to_buckets(batch)
+    assert check_padded(padded, n) == []
+
+
+def test_interner_index_bound_violation_golden(batch):
+    """A str_id pointing past the dictionary is exactly the bug
+    pack_batch's word0 gather cannot survive — ERROR KT311."""
+    V = int(batch.str_len.shape[0])
+    batch.str_id[0, 0, 0] = V  # one past the last dictionary row
+    diags = check_batch(batch)
+    (d,) = [x for x in diags if x.code == "KT311"]
+    assert d.severity.name == "ERROR"
+    assert d.component == "batch.str_id"
+    assert str(V) in d.message
+
+
+def test_negative_str_id_below_sentinel_flagged(batch):
+    batch.str_id[0, 0, 0] = -2  # -1 is the legal "no string" sentinel
+    assert "KT311" in _codes(check_batch(batch))
+
+
+def test_type_tag_out_of_range_flagged(batch):
+    batch.type_tag[0, 0, 0] = 7
+    assert "KT312" in _codes(check_batch(batch))
+
+
+def test_chk_path_out_of_range_flagged(compiled):
+    compiled.chk_path[0] = compiled.n_paths
+    diags = check_tensors(compiled)
+    assert any(d.code == "KT302" and d.component == "tensors.chk_path"
+               for d in diags)
+
+
+def test_nfa_id_out_of_range_flagged(compiled):
+    compiled.chk_nfa[:] = len(compiled.nfa_len) + 3
+    assert "KT302" in _codes(check_tensors(compiled))
+
+
+def test_dtype_violation_flagged(compiled):
+    compiled.chk_num_lo = compiled.chk_num_lo.astype(np.float64)
+    diags = check_tensors(compiled)
+    assert any(d.code == "KT301" and "chk_num_lo" in d.component
+               for d in diags)
+
+
+def test_padding_live_row_flagged(batch):
+    padded, n = pad_to_buckets(batch)
+    if padded.n == n:
+        pytest.skip("batch already power-of-two on every axis")
+    padded.live[-1] = True  # phantom resource in the pad region
+    assert "KT313" in _codes(check_padded(padded, n))
+
+
+def test_non_pow2_axis_flagged(batch):
+    diags = check_padded(batch, batch.n) if batch.n & (batch.n - 1) else []
+    # batch of 2 is a power of two; force the axis check directly
+    if not diags:
+        from dataclasses import replace
+
+        bad = replace(batch)
+        bad.__dict__["e"] = 3
+        diags = check_padded(bad, bad.n)
+    assert "KT313" in _codes(diags)

@@ -1,0 +1,136 @@
+"""Golden diagnostics: reachability / conflict pass (KT2xx)."""
+
+from kyverno_tpu.analysis import Severity, analyze_policies
+from kyverno_tpu.api.load import load_policy
+
+
+def _policy(name, rules):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": {"rules": rules},
+    })
+
+
+def _find(report, code):
+    return [d for d in report.diagnostics if d.code == code]
+
+
+def test_unreachable_rule_golden():
+    """match.any with an empty filter can never match ("match cannot be
+    empty" compiles to a constant-false row) — ERROR KT201."""
+    p = _policy("dead", [{
+        "name": "unreachable",
+        "match": {"any": [{}]},
+        "validate": {"pattern": {"metadata": {"name": "?*"}}},
+    }])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT201")
+    assert d.severity is Severity.ERROR
+    assert d.rule == "unreachable"
+    assert d.component == "match"
+    assert report.max_severity() is Severity.ERROR
+
+
+def test_exclude_all_kinds_is_unreachable():
+    p = _policy("excluded", [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "exclude": {"resources": {"kinds": ["*"]}},
+        "validate": {"pattern": {"metadata": {"name": "?*"}}},
+    }])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT201")
+    assert d.component == "exclude"
+
+
+def test_empty_any_preconditions_unreachable():
+    """A present-but-empty any list fails the conditions block outright
+    (evaluate.go nil-vs-empty distinction) — the rule never applies."""
+    p = _policy("pre", [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "preconditions": {"any": []},
+        "validate": {"pattern": {"metadata": {"name": "?*"}}},
+    }])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT201")
+    assert d.component == "preconditions"
+
+
+def test_shadowed_anypattern_branch_golden():
+    """Alternative 1 = alternative 0 plus an extra constraint: it can
+    only pass when alternative 0 already passed — WARNING KT202."""
+    p = _policy("shadow", [{
+        "name": "host-ns",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"anyPattern": [
+            {"spec": {"hostNetwork": False}},
+            {"spec": {"hostNetwork": False, "hostPID": False}},
+        ]},
+    }])
+    report = analyze_policies([p])
+    (d,) = _find(report, "KT202")
+    assert d.severity is Severity.WARNING
+    assert d.component == "anyPattern[alt=1]"
+    assert "alternative 0" in d.message
+
+
+def test_distinct_anypattern_branches_not_flagged():
+    p = _policy("ok", [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"anyPattern": [
+            {"spec": {"hostNetwork": False}},
+            {"spec": {"hostPID": False}},
+        ]},
+    }])
+    assert not _find(analyze_policies([p]), "KT202")
+
+
+def test_deny_constant_true_and_false():
+    true_p = _policy("deny-true", [{
+        "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"deny": {"conditions": {"all": [
+            {"key": "a", "operator": "Equals", "value": "a"}]}}},
+    }])
+    false_p = _policy("deny-false", [{
+        "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"deny": {"conditions": {"all": [
+            {"key": "a", "operator": "Equals", "value": "b"}]}}},
+    }])
+    assert _find(analyze_policies([true_p]), "KT203")
+    assert _find(analyze_policies([false_p]), "KT204")
+
+
+def test_content_dependent_rules_not_flagged():
+    """Rules whose outcome genuinely depends on the resource fold to
+    "unknown" and stay silent — the pass is sound, not heuristic."""
+    p = _policy("alive", [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"],
+                                "namespaces": ["prod-*"]}},
+        "preconditions": {"all": [
+            {"key": "{{request.object.metadata.name}}",
+             "operator": "NotEquals", "value": "skip-me"}]},
+        "validate": {"deny": {"conditions": {"any": [
+            {"key": "{{request.object.spec.replicas}}",
+             "operator": "GreaterThan", "value": 10}]}}},
+    }])
+    report = analyze_policies([p])
+    for code in ("KT201", "KT202", "KT203", "KT204"):
+        assert not _find(report, code), code
+
+
+def test_suppression_annotation_drops_codes():
+    p = load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "hush", "annotations": {
+            "kyverno-tpu.io/lint-suppress": "KT203, KT110"}},
+        "spec": {"rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"deny": {"conditions": {"all": [
+                {"key": "a", "operator": "Equals", "value": "a"}]}}},
+        }]},
+    })
+    report = analyze_policies([p])
+    assert not report.diagnostics

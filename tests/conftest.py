@@ -22,3 +22,22 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _tracer_leak_guard(request):
+    """Run every kernel test under jax.check_tracer_leaks: a helper that
+    stashes a traced value on a module global (an easy bug to write in
+    ops/ refactors) escapes unit assertions — the leaked tracer only
+    explodes much later, in an unrelated test's trace. Scoped to
+    tests/ops/ where everything traces; host-side suites skip the check
+    because it makes tracing measurably slower."""
+    path = getattr(request.node, "fspath", None)
+    in_ops = path is not None and f"{os.sep}ops{os.sep}" in str(path)
+    if not in_ops:
+        yield
+        return
+    with jax.check_tracer_leaks():
+        yield
