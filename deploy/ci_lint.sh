@@ -22,6 +22,9 @@ python -m kyverno_tpu.cli lint --self --fail-on error >/dev/null || rc=1
 echo "== policy static analysis (fail on ERROR diagnostics)"
 python -m kyverno_tpu.cli lint --fail-on error "${@:-tests/policies}" || rc=1
 
+echo "== pipeline parity smoke (serial vs pipelined dataflow)"
+JAX_PLATFORMS=cpu python deploy/pipeline_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

@@ -1,0 +1,80 @@
+"""Pipeline-parity smoke for CI (deploy/ci_lint.sh).
+
+Runs the same resource set through the serial dataflow
+(KTPU_FLATTEN_PIPELINE=0: plain flatten, blocking dispatch) and the
+pipelined one (row memo, splice, async double-buffered dispatch) and
+fails on any verdict difference. Fast by construction: one small policy
+set, a few hundred rows, CPU backend — the point is the diff, not the
+throughput. Exit 0 = parity, 1 = divergence.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "frac": i + 0.5}}
+
+
+def main() -> int:
+    import numpy as np
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.models import CompiledPolicySet
+
+    policies = [load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "m", "pattern": pattern},
+        }]},
+    }) for name, pattern in (
+        ("no-latest", {"spec": {"containers": [{"image": "!*:latest"}]}}),
+        ("weight-cap", {"spec": {"weight": "<=100"}}),
+    )]
+    cps = CompiledPolicySet(policies)
+    docs = [_pod(i) for i in range(384)]
+
+    os.environ["KTPU_FLATTEN_PIPELINE"] = "0"
+    v_serial = np.asarray(cps.evaluate_pipelined(docs, chunk=128))
+    os.environ["KTPU_FLATTEN_PIPELINE"] = "1"
+    v_pipe = np.asarray(cps.evaluate_pipelined(docs, chunk=128))
+
+    if not np.array_equal(v_serial, v_pipe):
+        diff = np.argwhere(v_serial != v_pipe)
+        print(f"pipeline_smoke: DIVERGENCE at {len(diff)} cells, "
+              f"first {diff[:5].tolist()}", file=sys.stderr)
+        return 1
+
+    # memo-splice lane: rows flattened once, spliced from the memo the
+    # second time, must score identically to the fresh flatten
+    from kyverno_tpu.models.flatten import (
+        split_packed_rows,
+        splice_packed_rows,
+    )
+
+    rows = split_packed_rows(cps.flatten_packed(docs[:64]))
+    v_spliced = np.asarray(cps.evaluate_device(splice_packed_rows(rows)))
+    v_fresh = np.asarray(cps.evaluate_device(cps.flatten_packed(docs[:64])))
+    if not np.array_equal(v_spliced, v_fresh):
+        print("pipeline_smoke: memo splice DIVERGENCE", file=sys.stderr)
+        return 1
+
+    print(f"pipeline_smoke: OK ({len(docs)} rows x "
+          f"{v_pipe.shape[1]} rules, serial == pipelined == spliced)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

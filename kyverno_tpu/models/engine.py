@@ -47,6 +47,33 @@ class RuleRef:
     rule_index: int
 
 
+class AsyncVerdicts:
+    """Handle on an in-flight device eval (evaluate_device_async). The
+    device computes while the dispatching thread does other host work;
+    :meth:`get` blocks on and host-materializes the verdict matrix (the
+    np.array transfer is the synchronization point) and caches it, so
+    repeated gets don't re-transfer."""
+
+    __slots__ = ("_out", "_verdicts")
+
+    def __init__(self, out):
+        self._out = out
+        self._verdicts: np.ndarray | None = None
+
+    def get(self) -> np.ndarray:
+        if self._verdicts is None:
+            self._verdicts = np.array(self._out)
+            self._out = None
+        return self._verdicts
+
+    def done(self) -> bool:
+        """Best-effort non-blocking completeness probe."""
+        if self._verdicts is not None:
+            return True
+        ready = getattr(self._out, "is_ready", None)
+        return bool(ready()) if callable(ready) else False
+
+
 class CompiledPolicySet:
     def __init__(self, policies: list):
         self.policies = list(policies)
@@ -121,6 +148,18 @@ class CompiledPolicySet:
         out = self.blob_eval_fn(blob, *shp)
         return np.array(out)
 
+    def evaluate_device_async(self, batch) -> "AsyncVerdicts":
+        """Dispatch the device eval WITHOUT blocking on the result.
+
+        JAX dispatch is asynchronous: the jitted call returns a
+        future-backed array immediately and the host thread is free until
+        something materializes it. The returned handle's :meth:`get` is
+        that materialization point — callers (AdmissionBatcher._flush,
+        evaluate_pipelined) flatten the NEXT window between dispatch and
+        get, which is where ``overlap_s_saved`` comes from."""
+        blob, shp = batch.packed_blob()
+        return AsyncVerdicts(self.blob_eval_fn(blob, *shp))
+
     # ------------------------------------------------------------ full
 
     def evaluate(self, resources: list[dict]) -> np.ndarray:
@@ -128,6 +167,54 @@ class CompiledPolicySet:
         batch = self.flatten(resources)
         verdicts = self.evaluate_device(batch)
         return self.resolve_host_cells(resources, verdicts)
+
+    def evaluate_pipelined(self, resources: list[dict],
+                           chunk: int = 1024) -> np.ndarray:
+        """Chunked :meth:`evaluate` with the scan pipeline: flatten chunk
+        k+1 on a prefetch thread while chunk k's device eval is in flight,
+        and resolve chunk k-1's host cells (CPU oracle) in the same
+        shadow. Falls back to the serial chunk loop when the
+        KTPU_FLATTEN_PIPELINE kill-switch is off. Verdicts are identical
+        to ``evaluate`` — rows flatten and score independently, so chunk
+        boundaries and overlap order can't change them."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .flatten import pipeline_enabled
+
+        if not resources:
+            return self.evaluate(resources)
+        if not pipeline_enabled() or len(resources) <= chunk:
+            if len(resources) <= chunk:
+                return self.evaluate(resources)
+            return np.concatenate([
+                self.evaluate(resources[i:i + chunk])
+                for i in range(0, len(resources), chunk)])
+
+        spans = [(i, min(i + chunk, len(resources)))
+                 for i in range(0, len(resources), chunk)]
+        out: list[np.ndarray] = []
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="ktpu-prefetch") as pool:
+            def flatten_span(span):
+                lo, hi = span
+                return self.flatten_packed(resources[lo:hi])
+
+            pending = pool.submit(flatten_span, spans[0])
+            in_flight: list[tuple] = []   # [(span, AsyncVerdicts)]
+            for k, span in enumerate(spans):
+                batch = pending.result()
+                if k + 1 < len(spans):
+                    pending = pool.submit(flatten_span, spans[k + 1])
+                handle = self.evaluate_device_async(batch)
+                in_flight.append((span, handle))
+                if len(in_flight) > 1:
+                    (lo, hi), done = in_flight.pop(0)
+                    out.append(self.resolve_host_cells(
+                        resources[lo:hi], done.get()))
+            for (lo, hi), done in in_flight:
+                out.append(self.resolve_host_cells(resources[lo:hi],
+                                                   done.get()))
+        return np.concatenate(out)
 
     def resolve_host_cells(self, resources: list[dict],
                            verdicts: np.ndarray,

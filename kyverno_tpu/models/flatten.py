@@ -26,6 +26,7 @@ dedup that makes the string path cheap on device.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -391,6 +392,157 @@ def pad_to_buckets_packed(batch: PackedBatch) -> tuple[PackedBatch, int]:
     str_bytes = np.pad(batch.str_bytes, [(0, v2 - V), (0, 0)])
     return PackedBatch(n=b2, e=e2, cells=cells, bmeta=bmeta,
                        str_bytes=str_bytes, dictv=dictv), B
+
+
+def pipeline_enabled() -> bool:
+    """KTPU_FLATTEN_PIPELINE=0 kill-switch: read dynamically at every use
+    site so an operator (or a test monkeypatching os.environ) can drop the
+    whole admission/scan path back to the serial dataflow without a
+    restart."""
+    return os.environ.get("KTPU_FLATTEN_PIPELINE", "1") != "0"
+
+
+@dataclass
+class PackedRow:
+    """One resource's slice of a PackedBatch, rebased onto a private
+    string table — the unit of the flatten-row memo (runtime/resourcecache
+    FlattenRowCache). ``cells`` is trimmed to the row's own slot count and
+    ``str_bytes``/``dictv`` keep only the rows this resource references,
+    so a memoized row costs O(own content), not O(original batch)."""
+
+    cells: np.ndarray       # [P, e_row, 2] uint32, w0 rebased to local ids
+    bmeta: int              # uint32 scalar
+    str_bytes: np.ndarray   # [v, STR_LEN] uint8 (may be empty)
+    dictv: np.ndarray       # [v, 5] uint32
+
+    @property
+    def nbytes(self) -> int:
+        return self.cells.nbytes + self.str_bytes.nbytes + self.dictv.nbytes
+
+
+def split_packed_rows(batch: PackedBatch) -> list[PackedRow]:
+    """Decompose a freshly-flattened PackedBatch into per-resource rows.
+
+    Per row the trailing all-zero slot columns are trimmed (zero fill is
+    the dead encoding, so they are pure padding) and word0 string ids are
+    rebased through a per-row LUT onto a compact private table. The
+    inverse is splice_packed_rows; split→splice of every row reproduces
+    the batch's verdicts exactly (dictionary value lanes are pure
+    functions of the interned string and class-gated on read, so the
+    re-merged table can only differ in lanes the kernels never read)."""
+    cells, bmeta = np.asarray(batch.cells), np.asarray(batch.bmeta)
+    str_bytes, dictv = np.asarray(batch.str_bytes), np.asarray(batch.dictv)
+    rows: list[PackedRow] = []
+    for b in range(int(batch.n)):
+        rc = cells[b]                             # [P, E, 2]
+        used = rc.any(axis=2).any(axis=0)         # [E] slot columns in use
+        e_row = int(np.max(np.nonzero(used)[0]) + 1) if used.any() else 0
+        rc = rc[:, :e_row, :]
+        w0 = rc[..., 0]
+        ids = np.unique(w0)
+        ids = (ids[ids > 0] - 1).astype(np.int64)
+        lut = np.zeros(int(dictv.shape[0]) + 1, dtype=np.uint32)
+        lut[ids + 1] = np.arange(1, len(ids) + 1, dtype=np.uint32)
+        rc = np.stack([lut[w0], rc[..., 1]], axis=-1)
+        rows.append(PackedRow(
+            cells=np.ascontiguousarray(rc),
+            bmeta=int(bmeta[b]),
+            str_bytes=np.ascontiguousarray(str_bytes[ids]),
+            dictv=np.ascontiguousarray(dictv[ids]),
+        ))
+    return rows
+
+
+def splice_packed_rows(rows: list[PackedRow]) -> PackedBatch:
+    """Reassemble memoized PackedRows into one PackedBatch: re-intern each
+    row's private string table into a shared batch table and remap word0
+    through the resulting LUT. Strings are keyed by (padded bytes, length)
+    — the length disambiguates texts whose UTF-8 ends in NUL bytes —
+    and duplicate dictionary rows merge by elementwise OR, which is exact
+    because value lanes are pure functions of the string (lanes set by two
+    rows agree; lanes set by neither stay zero)."""
+    B = len(rows)
+    P = int(rows[0].cells.shape[0]) if B else 0
+    E = max([int(r.cells.shape[1]) for r in rows], default=0)
+    E = max(E, 1)
+    index: dict[tuple[bytes, int], int] = {}
+    sb_rows: list[np.ndarray] = []
+    dv_rows: list[np.ndarray] = []
+    cells = np.zeros((B, P, E, 2), dtype=np.uint32)
+    bmeta = np.zeros(B, dtype=np.uint32)
+    for b, row in enumerate(rows):
+        v = int(row.dictv.shape[0])
+        lut = np.zeros(v + 1, dtype=np.uint32)
+        for i in range(v):
+            key = (row.str_bytes[i].tobytes(), int(row.dictv[i, 4] & 0x7F))
+            j = index.get(key)
+            if j is None:
+                j = len(sb_rows)
+                index[key] = j
+                sb_rows.append(row.str_bytes[i])
+                dv_rows.append(row.dictv[i].copy())
+            else:
+                dv_rows[j] |= row.dictv[i]
+            lut[i + 1] = j + 1
+        e_row = int(row.cells.shape[1])
+        cells[b, :, :e_row, 0] = lut[row.cells[..., 0]]
+        cells[b, :, :e_row, 1] = row.cells[..., 1]
+        bmeta[b] = row.bmeta
+    V = len(sb_rows)
+    if V:
+        str_bytes = np.stack(sb_rows).astype(np.uint8)
+        dictv = np.stack(dv_rows).astype(np.uint32)
+    else:
+        str_bytes = np.zeros((1, STR_LEN), dtype=np.uint8)
+        dictv = np.zeros((1, 5), dtype=np.uint32)
+    return PackedBatch(n=B, e=E, cells=cells, bmeta=bmeta,
+                       str_bytes=str_bytes, dictv=dictv)
+
+
+def merge_packed(chunks: list[PackedBatch]) -> PackedBatch:
+    """Concatenate independently-flattened PackedBatches (the chunked
+    multi-worker native flatten) into one batch: slot axes pad up to the
+    widest chunk and the per-chunk string tables re-intern into a shared
+    one with the same (bytes, length) key and OR-merge as
+    splice_packed_rows."""
+    if len(chunks) == 1:
+        return chunks[0]
+    B = sum(int(c.n) for c in chunks)
+    P = int(chunks[0].cells.shape[1])
+    E = max(1, max(int(c.e) for c in chunks))
+    cells = np.zeros((B, P, E, 2), dtype=np.uint32)
+    bmeta = np.zeros(B, dtype=np.uint32)
+    index: dict[tuple[bytes, int], int] = {}
+    sb_rows: list[np.ndarray] = []
+    dv_rows: list[np.ndarray] = []
+    at = 0
+    for c in chunks:
+        c_sb, c_dv = np.asarray(c.str_bytes), np.asarray(c.dictv)
+        v = int(c_dv.shape[0])
+        lut = np.zeros(v + 1, dtype=np.uint32)
+        for i in range(v):
+            key = (c_sb[i].tobytes(), int(c_dv[i, 4] & 0x7F))
+            j = index.get(key)
+            if j is None:
+                j = len(sb_rows)
+                index[key] = j
+                sb_rows.append(c_sb[i])
+                dv_rows.append(c_dv[i].copy())
+            else:
+                dv_rows[j] |= c_dv[i]
+            lut[i + 1] = j + 1
+        cc = np.asarray(c.cells)
+        n, e = int(c.n), int(cc.shape[2])
+        cells[at:at + n, :, :e, 0] = lut[cc[:n, :, :, 0]]
+        cells[at:at + n, :, :e, 1] = cc[:n, :, :, 1]
+        bmeta[at:at + n] = np.asarray(c.bmeta)[:n]
+        at += n
+    str_bytes = np.stack(sb_rows).astype(np.uint8) if sb_rows else \
+        np.zeros((1, STR_LEN), dtype=np.uint8)
+    dictv = np.stack(dv_rows).astype(np.uint32) if dv_rows else \
+        np.zeros((1, 5), dtype=np.uint32)
+    return PackedBatch(n=B, e=E, cells=cells, bmeta=bmeta,
+                       str_bytes=str_bytes, dictv=dictv)
 
 
 class _Interner:

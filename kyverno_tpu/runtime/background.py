@@ -107,17 +107,24 @@ class BackgroundScanner:
 
             verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
         else:
+            from ..models.flatten import pipeline_enabled
             from ..parallel.mesh import DEFAULT_CHUNK
 
-            if len(resources) > DEFAULT_CHUNK:
+            if len(resources) <= DEFAULT_CHUNK:
+                verdicts = self.cps.evaluate(resources)
+            elif pipeline_enabled():
+                # scan-chunk prefetch: flatten chunk k+1 while the device
+                # scores chunk k (KTPU_FLATTEN_PIPELINE=0 falls back to
+                # the serial chunk loop below)
+                verdicts = self.cps.evaluate_pipelined(resources,
+                                                       chunk=DEFAULT_CHUNK)
+            else:
                 # chunk huge snapshots so flatten memory stays bounded
                 import numpy as _np
 
                 verdicts = _np.concatenate([
                     self.cps.evaluate(resources[i:i + DEFAULT_CHUNK])
                     for i in range(0, len(resources), DEFAULT_CHUNK)])
-            else:
-                verdicts = self.cps.evaluate(resources)
 
         for b, resource in enumerate(resources):
             meta = resource.get("metadata") or {}

@@ -102,7 +102,8 @@ class AdmissionBatcher:
                  circuit_cooldown_s: float = 5.0,
                  result_cache_ttl_s: float = 1.0,
                  result_cache_max: int = 4096,
-                 resolve_host_in_flush: bool = True):
+                 resolve_host_in_flush: bool = True,
+                 row_cache_max: int = 4096):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
@@ -172,6 +173,16 @@ class AdmissionBatcher:
         self.result_cache_ttl_s = result_cache_ttl_s
         self.result_cache_max = result_cache_max
         self._result_cache: dict = {}
+        # flatten-row memo (tentpole piece 1): per-resource flattened rows
+        # keyed by (tensors fingerprint, resource digest). Orthogonal to
+        # the decision cache above: a burst of DISTINCT resources misses
+        # every decision key, but repeat resource *shapes* (the same Pod
+        # re-admitted, a warmup resource, a retried request) still skip
+        # the flatten. Fingerprint keying makes recompile invalidation
+        # structural — a new path dictionary is a new key space.
+        from .resourcecache import FlattenRowCache
+
+        self._row_cache = FlattenRowCache(max_rows=row_cache_max)
         # per-CompiledPolicySet shape buckets already compiled; weak keys
         # so dead policy generations vanish (an id()-keyed set could both
         # leak and misclassify a fresh compile after id reuse)
@@ -300,21 +311,37 @@ class AdmissionBatcher:
             return
         if not cps.policies:
             return
-        for b in batch_sizes:
-            try:
-                batch, _ = self._pad_admission(
-                    cps.flatten_packed([resource] * b))
-                shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
-                cps.evaluate_device(batch)          # compile
-                t0 = time.monotonic()
-                cps.evaluate_device(batch)          # measure steady state
-                dt = time.monotonic() - t0
-            except Exception:
-                continue
-            with self._lock:
-                self._seen_shapes.setdefault(cps, set()).add(shape_key)
-                self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
-                self._last_dispatch = time.monotonic()
+        # each size warms on a flush-pool worker through the same
+        # memoized-flatten + async-dispatch path live flushes use, so a
+        # warmup triggered by a policy change can't serialize in front of
+        # a live flush on the caller's thread (it competes for a pool
+        # slot like any other flush, nothing more). [resource] * b also
+        # seeds the flatten-row memo: one miss, b-1 hits.
+        futs = [self._flush_pool.submit(self._warmup_one, cps, resource, b)
+                for b in batch_sizes]
+        for f in futs:
+            with contextlib.suppress(Exception):
+                f.result()
+
+    def _warmup_one(self, cps, resource: dict, b: int) -> None:
+        raw, _, _, deferred = self._flatten_flush(cps, [resource] * b)
+        batch, _ = self._pad_admission(raw)
+        shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
+        handle = cps.evaluate_device_async(batch)   # compile
+        if deferred is not None:
+            from ..models.flatten import split_packed_rows
+
+            fp, digests, fresh = deferred
+            for d, row in zip(digests, split_packed_rows(fresh)):
+                self._row_cache.put(fp, d, row)
+        handle.get()
+        t0 = time.monotonic()
+        cps.evaluate_device_async(batch).get()      # measure steady state
+        dt = time.monotonic() - t0
+        with self._lock:
+            self._seen_shapes.setdefault(cps, set()).add(shape_key)
+            self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
+            self._last_dispatch = time.monotonic()
 
     # ------------------------------------------------------------- cache
 
@@ -585,11 +612,47 @@ class AdmissionBatcher:
             with self._lock:
                 self._pending_flushes -= 1
 
+    def _flatten_flush(self, cps, resources):
+        """Row-memoized flatten for one flush window.
+
+        Returns ``(batch, n_hits, n_miss, deferred)`` — hit/miss counts
+        are memo traffic, so both stay 0 when the kill-switch bypasses
+        the memo entirely. On zero memo hits the
+        directly-flattened batch comes back untouched (bit-identical to
+        the pre-memo path) and ``deferred`` carries what the caller
+        splits+stores INSIDE the async-dispatch shadow; on any hit the
+        hit rows splice with a single flatten of the misses (stored
+        immediately — the split already happened). Kill-switch off means
+        plain flatten, no memo traffic at all."""
+        from ..models.flatten import (pipeline_enabled, split_packed_rows,
+                                      splice_packed_rows)
+
+        if not pipeline_enabled():
+            return cps.flatten_packed(resources), 0, 0, None
+        fp = cps.tensors.fingerprint
+        cache = self._row_cache
+        digests = [cache.digest(r) for r in resources]
+        rows = [cache.get(fp, d) for d in digests]
+        n_hits = sum(r is not None for r in rows)
+        if n_hits == 0:
+            batch = cps.flatten_packed(resources)
+            return batch, 0, len(resources), (fp, digests, batch)
+        miss_idx = [i for i, r in enumerate(rows) if r is None]
+        if miss_idx:
+            miss_rows = split_packed_rows(
+                cps.flatten_packed([resources[i] for i in miss_idx]))
+            for j, i in enumerate(miss_idx):
+                rows[i] = miss_rows[j]
+                cache.put(fp, digests[i], miss_rows[j])
+        return splice_packed_rows(rows), n_hits, len(miss_idx), None
+
     def _flush(self, cps, items, is_probe: bool = False) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
+            from ..models.flatten import pipeline_enabled, split_packed_rows
+
             for *_, fut in items:
                 # waiters whose adaptive deadline expires while this
                 # flush is under way keep waiting (screen() checks this)
@@ -597,13 +660,16 @@ class AdmissionBatcher:
             resources = [r for r, _, _ in items]
             t0 = time.monotonic()
             cpu0 = time.thread_time()
+            raw, n_hits, n_miss, deferred = self._flatten_flush(cps,
+                                                                resources)
             # bucket the batch shape (pow2 + admission floor) so XLA
             # compiles once per bucket, not once per admission batch
-            batch, _ = self._pad_admission(cps.flatten_packed(resources))
+            batch, _ = self._pad_admission(raw)
             shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
             with self._lock:
                 cold = shape_key not in self._seen_shapes.setdefault(cps,
                                                                      set())
+                queue_depth = self._pending_flushes
             if cold and self.cold_flush_fallback and not is_probe:
                 # this flush is about to pay XLA compilation — release the
                 # waiters to the oracle now and let the compile warm the
@@ -612,7 +678,31 @@ class AdmissionBatcher:
                     if not fut.done():
                         # cold-fallback release: the device did NOT answer
                         fut.set_result((ATTENTION, [], False))
-            verdicts = np.asarray(cps.evaluate_device(batch))
+            # async dispatch (tentpole piece 3): the device starts on this
+            # batch NOW; the host thread spends the flight time on work
+            # that used to run after the blocking eval — splitting and
+            # storing this window's memo rows — and only materializes
+            # verdicts when the scatter below needs them. With the 4-way
+            # flush pool this also lets flush N+1's flatten (its own
+            # worker) overlap flush N's device time.
+            overlap_s = 0.0
+            if pipeline_enabled() and not cold:
+                handle = cps.evaluate_device_async(batch)
+                t_disp = time.monotonic()
+                if deferred is not None:
+                    fp, digests, fresh = deferred
+                    for d, row in zip(digests, split_packed_rows(fresh)):
+                        self._row_cache.put(fp, d, row)
+                    overlap_s = time.monotonic() - t_disp
+                verdicts = handle.get()
+            else:
+                # cold flush: the "dispatch" is an XLA compile holding the
+                # host anyway — overlap buys nothing, keep it simple
+                verdicts = np.asarray(cps.evaluate_device(batch))
+                if deferred is not None:
+                    fp, digests, fresh = deferred
+                    for d, row in zip(digests, split_packed_rows(fresh)):
+                        self._row_cache.put(fp, d, row)
             dt = time.monotonic() - t0
             cpu_dt = time.thread_time() - cpu0
             with self._lock:
@@ -689,7 +779,10 @@ class AdmissionBatcher:
                 if not fut.done():
                     fut.set_result((CLEAN if clean else ATTENTION, row, True))
             self._note_flush_stats(len(items), host_resolved, flush_cells,
-                                   flagged_rules, esc)
+                                   flagged_rules, esc, n_hits=n_hits,
+                                   n_miss=n_miss,
+                                   overlap_s=overlap_s,
+                                   queue_depth=queue_depth)
         except Exception:
             for *_, fut in items:
                 if not fut.done():
@@ -749,7 +842,9 @@ class AdmissionBatcher:
 
     def _note_flush_stats(self, batch_size: int, host_resolved: int,
                           flush_cells: dict, flagged_rules: dict,
-                          esc: dict) -> None:
+                          esc: dict, n_hits: int = 0, n_miss: int = 0,
+                          overlap_s: float = 0.0,
+                          queue_depth: int = 0) -> None:
         """Fold one flush's diagnostics into stats + the metrics registry
         (the routing split must be observable in production, not just in
         bench output)."""
@@ -765,6 +860,18 @@ class AdmissionBatcher:
                 flagged[k] = flagged.get(k, 0) + n
             for k, n in esc.items():
                 self.stats[f"esc_{k}"] = self.stats.get(f"esc_{k}", 0) + n
+            # pipeline stage counters: rows served from the flatten memo
+            # vs flattened fresh, and host seconds spent inside the async
+            # dispatch's shadow (work that used to serialize after eval)
+            if n_hits:
+                self.stats["flatten_cache_hit_rows"] = (
+                    self.stats.get("flatten_cache_hit_rows", 0) + n_hits)
+            if n_miss:
+                self.stats["flatten_cache_miss_rows"] = (
+                    self.stats.get("flatten_cache_miss_rows", 0) + n_miss)
+            if overlap_s > 0:
+                self.stats["overlap_s_saved"] = (
+                    self.stats.get("overlap_s_saved", 0.0) + overlap_s)
         try:
             from . import metrics as metrics_mod
 
@@ -773,6 +880,10 @@ class AdmissionBatcher:
                                            host_resolved=host_resolved)
             for k, n in esc.items():
                 metrics_mod.record_screen_escalation(reg, k, n)
+            metrics_mod.record_flatten_rows(reg, hits=n_hits, misses=n_miss)
+            if overlap_s > 0:
+                metrics_mod.record_pipeline_overlap(reg, overlap_s)
+            metrics_mod.record_flush_queue_depth(reg, queue_depth)
         except Exception:
             pass
 

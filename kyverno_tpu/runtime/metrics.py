@@ -183,6 +183,38 @@ def record_host_rule_info(registry: MetricsRegistry, policy: str, rule: str,
     }, 1.0)
 
 
+def record_flatten_rows(registry: MetricsRegistry, hits: int = 0,
+                        misses: int = 0) -> None:
+    """Flatten-row memo traffic (runtime/batch.py _flatten_flush): a row
+    served from the content-addressed cache skipped its share of the
+    host flatten entirely. Hit ratio ~0 on cache-adversarial workloads
+    is expected — the memo keys resource *content*, not decisions."""
+    if hits:
+        registry.inc_counter("kyverno_flatten_rows_total",
+                             {"result": "hit"}, float(hits))
+    if misses:
+        registry.inc_counter("kyverno_flatten_rows_total",
+                             {"result": "miss"}, float(misses))
+
+
+def record_pipeline_overlap(registry: MetricsRegistry,
+                            seconds: float) -> None:
+    """Host seconds spent doing useful work (memo row split/store, next
+    window's flatten) inside an async device dispatch's shadow — time
+    the serial dataflow would have added to the critical path."""
+    registry.inc_counter("kyverno_pipeline_overlap_seconds_total", {},
+                         seconds)
+
+
+def record_flush_queue_depth(registry: MetricsRegistry, depth: int) -> None:
+    """Flushes already submitted/in flight when a new flush dispatches —
+    the pipeline's fill level. 0 = every flush ran alone (no cross-flush
+    overlap); sustained depth near the pool size means the device lane
+    is saturated and the window should widen."""
+    registry.set_gauge("kyverno_admission_flush_queue_depth", {},
+                       float(depth))
+
+
 def record_screen_escalation(registry: MetricsRegistry, reason: str,
                              value: float = 1.0) -> None:
     """Why a screened admission row escalated past CLEAN — the routing

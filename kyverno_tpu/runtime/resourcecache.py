@@ -189,3 +189,78 @@ class ResourceCache:
                 self._entries.clear()
             else:
                 self._entries.pop(self._key(kind, namespace, name), None)
+
+
+class FlattenRowCache:
+    """Content-addressed memo of per-resource flattened rows
+    (models/flatten.py PackedRow), keyed by (PolicyTensors fingerprint,
+    canonical resource digest).
+
+    The fingerprint covers exactly what flattening consumes — the path
+    dictionary and kind index — so a policy recompile that moves the
+    dictionary gets a different key space and stale rows can never splice
+    into a new tensor set's batch (no explicit invalidation protocol to
+    get wrong); recompiles that leave the dictionary untouched keep their
+    hits. The digest is the blake2b of the sorted-key JSON of the
+    (resource, request-envelope) pair — flattening never depends on dict
+    key order, so the canonicalization is sound, and resources that JSON
+    can't serialize simply skip the memo (the native flattener routes
+    those to the host lane anyway). LRU-bounded by row count."""
+
+    def __init__(self, max_rows: int = 4096):
+        from collections import OrderedDict
+
+        self.max_rows = max_rows
+        self._lock = threading.Lock()
+        self._rows: "OrderedDict[tuple[str, bytes], object]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def digest(resource: dict, request: dict | None = None) -> bytes | None:
+        import hashlib
+        import json
+
+        try:
+            blob = json.dumps((resource, request), sort_keys=True,
+                              separators=(",", ":"),
+                              allow_nan=False).encode("utf-8")
+        except (TypeError, ValueError):
+            return None
+        return hashlib.blake2b(blob, digest_size=16).digest()
+
+    def get(self, fingerprint: str, digest: bytes | None):
+        if digest is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        with self._lock:
+            row = self._rows.get((fingerprint, digest))
+            if row is None:
+                self.misses += 1
+                return None
+            self._rows.move_to_end((fingerprint, digest))
+            self.hits += 1
+            return row
+
+    def put(self, fingerprint: str, digest: bytes | None, row) -> None:
+        if digest is None:
+            return
+        with self._lock:
+            self._rows[(fingerprint, digest)] = row
+            self._rows.move_to_end((fingerprint, digest))
+            while len(self._rows) > self.max_rows:
+                self._rows.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"rows": len(self._rows), "hits": self.hits,
+                    "misses": self.misses}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()

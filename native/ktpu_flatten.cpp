@@ -361,6 +361,19 @@ bool quantity_to_micro(std::string_view s, int64_t* out,
     return true;
 }
 
+// std::from_chars for double is absent in libstdc++ < 11; strtod on the
+// NUL-terminated copy parses the same token (callers pre-validate the
+// digit shape, and LC_NUMERIC stays "C" inside extension modules).
+inline double parse_double_tok(const std::string& tok) {
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+    double v = 0.0;
+    std::from_chars(tok.data(), tok.data() + tok.size(), v);
+    return v;
+#else
+    return strtod(tok.c_str(), nullptr);
+#endif
+}
+
 // Go strconv.FormatFloat(v,'E',-1,64) — shortest mantissa, E+NN exponent
 // (utils/gofmt.py format_float_sci).
 std::string format_float_sci(double v) {
@@ -368,8 +381,19 @@ std::string format_float_sci(double v) {
     if (v == __builtin_inf()) return "+Inf";
     if (v == -__builtin_inf()) return "-Inf";
     char buf[64];
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     auto res = std::to_chars(buf, buf + sizeof buf, v);  // shortest repr
     std::string shortest(buf, res.ptr);
+#else
+    // libstdc++ < 11 has no floating-point to_chars: find the shortest
+    // %g precision that round-trips — same digits as to_chars (minimal
+    // length, correctly rounded), so byte parity with gofmt.py holds
+    for (int prec = 1; prec <= 17; ++prec) {
+        snprintf(buf, sizeof buf, "%.*g", prec, v);
+        if (strtod(buf, nullptr) == v) break;
+    }
+    std::string shortest(buf);
+#endif
 
     bool neg = false;
     std::string digits = shortest;
@@ -460,11 +484,7 @@ bool parse_duration_secs(std::string_view s, double* out) {
             else break;
         }
         if (nd == 0 && nf == 0) return false;
-        double v = 0.0;
-        {
-            std::string tok(s.substr(start, i - start));
-            std::from_chars(tok.data(), tok.data() + tok.size(), v);
-        }
+        double v = parse_double_tok(std::string(s.substr(start, i - start)));
         // unit (longest match first): ns us µs μs ms s m h
         double unit;
         if (s.compare(i, 2, "ns") == 0) { unit = 1e-9; i += 2; }
@@ -909,10 +929,7 @@ int ktpu_flatten_batch(
                             text = std::string(v->raw);
                             if (!text.empty() && text[0] == '+') text.erase(0, 1);
                         } else {
-                            double fv = 0.0;
-                            std::string tok(v->raw);
-                            std::from_chars(tok.data(),
-                                            tok.data() + tok.size(), fv);
+                            double fv = parse_double_tok(std::string(v->raw));
                             text = format_float_sci(fv);
                         }
                         if (int(text.size()) <= L) str_id[o] = interner.intern(text);
@@ -1167,10 +1184,8 @@ struct PackedCore {
                                 if (!text.empty() && text[0] == '+')
                                     text.erase(0, 1);
                             } else {
-                                double fv = 0.0;
-                                std::string tok(v->raw);
-                                std::from_chars(tok.data(),
-                                                tok.data() + tok.size(), fv);
+                                double fv =
+                                    parse_double_tok(std::string(v->raw));
                                 text = format_float_sci(fv);
                             }
                             if (int(text.size()) <= L) {

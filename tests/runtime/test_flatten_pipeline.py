@@ -1,0 +1,353 @@
+"""Pipelined admission/scan dataflow: flatten-row memo, splice parity,
+async dispatch, and the KTPU_FLATTEN_PIPELINE kill-switch.
+
+The contract under test is bit-for-bit honesty: the pipelined dataflow
+(memoized rows spliced into fresh batches, chunked flattens merged,
+windows flattened during device flight) must produce verdicts identical
+to the serial flatten-then-eval path, and the kill-switch must drop
+every layer back to that serial path at once.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models import CompiledPolicySet, Verdict
+from kyverno_tpu.models.flatten import (
+    merge_packed,
+    pipeline_enabled,
+    split_packed_rows,
+    splice_packed_rows,
+)
+from kyverno_tpu.runtime.batch import ATTENTION, CLEAN, AdmissionBatcher
+from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+from kyverno_tpu.runtime.resourcecache import FlattenRowCache
+
+
+def _policy(name="p", kinds=("Pod",), pattern=None):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r",
+            "match": {"resources": {"kinds": list(kinds)}},
+            "validate": {"message": "m", "pattern": pattern or {
+                "spec": {"containers": [{"image": "!*:latest"}]}}},
+        }]},
+    })
+
+
+# mixed-shape policy set: string globs, numeric bounds, durations —
+# exercises every dictionary value lane the splice OR-merge touches
+POLICIES = [
+    _policy("no-latest"),
+    _policy("weight-cap", pattern={"spec": {"weight": "<=100"}}),
+    _policy("grace", pattern={"spec": {"grace": "<1h"}}),
+]
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "frac": i + 0.5,
+                     "grace": f"{(i * 13) % 400}s"}}
+
+
+@pytest.fixture(scope="module")
+def cps():
+    return CompiledPolicySet(POLICIES)
+
+
+class TestSplitSplice:
+    def test_round_trip_is_bit_identical_per_row(self, cps):
+        """split → splice of every row of one batch reproduces verdicts
+        exactly, and unpadded content byte-for-byte."""
+        docs = [_pod(i) for i in range(16)]
+        batch = cps.flatten_packed(docs)
+        rows = split_packed_rows(batch)
+        assert len(rows) == 16
+        spliced = splice_packed_rows(rows)
+        v_direct = np.asarray(cps.evaluate_device(batch))
+        v_spliced = np.asarray(cps.evaluate_device(spliced))
+        assert np.array_equal(v_direct, v_spliced)
+
+    def test_splice_across_batches(self, cps):
+        """Rows memoized from DIFFERENT source batches splice into one
+        batch whose verdicts match flattening those resources together —
+        the actual memo-hit shape in _flatten_flush."""
+        docs_a = [_pod(i) for i in range(0, 8)]
+        docs_b = [_pod(i) for i in range(8, 16)]
+        rows_a = split_packed_rows(cps.flatten_packed(docs_a))
+        rows_b = split_packed_rows(cps.flatten_packed(docs_b))
+        # interleave: hit, miss, hit, miss ...
+        rows = [r for pair in zip(rows_a, rows_b) for r in pair]
+        docs = [d for pair in zip(docs_a, docs_b) for d in pair]
+        v_spliced = np.asarray(cps.evaluate_device(splice_packed_rows(rows)))
+        v_direct = np.asarray(cps.evaluate_device(cps.flatten_packed(docs)))
+        assert np.array_equal(v_direct, v_spliced)
+
+    def test_merge_packed_matches_whole_batch_flatten(self, cps):
+        """The chunked multi-worker flatten's merge: independently
+        flattened chunks concatenate to the whole batch's verdicts."""
+        docs = [_pod(i) for i in range(24)]
+        chunks = [cps.flatten_packed(docs[i:i + 7])
+                  for i in range(0, 24, 7)]
+        merged = merge_packed(chunks)
+        assert merged.n == 24
+        v_merged = np.asarray(cps.evaluate_device(merged))
+        v_direct = np.asarray(cps.evaluate_device(cps.flatten_packed(docs)))
+        assert np.array_equal(v_direct, v_merged)
+
+    def test_merge_single_chunk_is_identity(self, cps):
+        batch = cps.flatten_packed([_pod(1), _pod(2)])
+        assert merge_packed([batch]) is batch
+
+
+class TestFlattenRowCache:
+    def test_digest_canonicalizes_key_order(self):
+        a = {"kind": "Pod", "spec": {"x": 1, "y": 2}}
+        b = {"spec": {"y": 2, "x": 1}, "kind": "Pod"}
+        assert FlattenRowCache.digest(a) == FlattenRowCache.digest(b)
+        assert FlattenRowCache.digest(a) != FlattenRowCache.digest(
+            {"kind": "Pod", "spec": {"x": 1, "y": 3}})
+
+    def test_digest_unserializable_is_none_and_counts_miss(self):
+        cache = FlattenRowCache()
+        d = FlattenRowCache.digest({"spec": {"x": object()}})
+        assert d is None
+        assert cache.get("fp", d) is None
+        assert cache.stats()["misses"] == 1
+        cache.put("fp", None, "row")     # silently skipped
+        assert len(cache) == 0
+
+    def test_lru_eviction_and_counters(self):
+        cache = FlattenRowCache(max_rows=4)
+        digs = [FlattenRowCache.digest({"i": i}) for i in range(6)]
+        for i in range(4):
+            cache.put("fp", digs[i], f"row{i}")
+        assert cache.get("fp", digs[0]) == "row0"    # refresh 0
+        cache.put("fp", digs[4], "row4")             # evicts 1 (LRU)
+        cache.put("fp", digs[5], "row5")             # evicts 2
+        assert len(cache) == 4
+        assert cache.get("fp", digs[1]) is None
+        assert cache.get("fp", digs[2]) is None
+        assert cache.get("fp", digs[0]) == "row0"
+        s = cache.stats()
+        assert s["hits"] == 2 and s["misses"] == 2
+
+    def test_fingerprint_partitions_key_space(self):
+        """Rows stored under one tensor-set fingerprint are invisible to
+        another — the structural stale-row invalidation."""
+        cache = FlattenRowCache()
+        d = FlattenRowCache.digest({"kind": "Pod"})
+        cache.put("fp-old", d, "old-row")
+        assert cache.get("fp-new", d) is None
+        assert cache.get("fp-old", d) == "old-row"
+
+
+class TestFingerprint:
+    def test_path_dictionary_changes_fingerprint(self):
+        a = CompiledPolicySet([_policy("a", pattern={"spec": {"x": "<1"}})])
+        b = CompiledPolicySet([_policy("a", pattern={"spec": {"y": "<1"}})])
+        assert a.tensors.fingerprint != b.tensors.fingerprint
+
+    def test_value_only_recompile_keeps_fingerprint(self):
+        a = CompiledPolicySet([_policy("a", pattern={"spec": {"x": "<1"}})])
+        b = CompiledPolicySet([_policy("a", pattern={"spec": {"x": "<9"}})])
+        assert a.tensors.fingerprint == b.tensors.fingerprint
+
+
+class TestFlattenerCacheBound:
+    def test_cache_is_bounded_across_distinct_path_dicts(self):
+        """Regression for the old mutable-default ``_cache={}``: compiling
+        many policy sets with genuinely different path dictionaries must
+        not grow the flattener-handle cache without bound."""
+        import kyverno_tpu.models.native_flatten as nf
+
+        with nf._flattener_lock:
+            nf._flattener_cache.clear()
+        sets = [CompiledPolicySet([_policy(
+            "p", pattern={"spec": {f"field{i}": "<10"}})])
+            for i in range(nf._FLATTENER_CACHE_CAP + 3)]
+        for s in sets:
+            nf._flattener_for(s.tensors)
+        with nf._flattener_lock:
+            assert len(nf._flattener_cache) <= nf._FLATTENER_CACHE_CAP
+
+    def test_same_fingerprint_shares_one_handle(self):
+        import kyverno_tpu.models.native_flatten as nf
+
+        a = CompiledPolicySet([_policy("a", pattern={"spec": {"z": "<1"}})])
+        b = CompiledPolicySet([_policy("a", pattern={"spec": {"z": "<5"}})])
+        assert nf._flattener_for(a.tensors) is nf._flattener_for(b.tensors)
+
+
+class TestEvaluatePipelined:
+    def test_parity_with_serial_evaluate(self, cps):
+        docs = [_pod(i) for i in range(300)]
+        v_pipe = np.asarray(cps.evaluate_pipelined(docs, chunk=64))
+        v_serial = np.concatenate([
+            np.asarray(cps.evaluate(docs[i:i + 64]))
+            for i in range(0, len(docs), 64)])
+        assert np.array_equal(v_pipe, v_serial)
+
+    def test_kill_switch_forces_serial_and_matches(self, cps, monkeypatch):
+        docs = [_pod(i) for i in range(150)]
+        v_on = np.asarray(cps.evaluate_pipelined(docs, chunk=64))
+        monkeypatch.setenv("KTPU_FLATTEN_PIPELINE", "0")
+        assert not pipeline_enabled()
+        v_off = np.asarray(cps.evaluate_pipelined(docs, chunk=64))
+        assert np.array_equal(v_on, v_off)
+
+    def test_small_input_takes_direct_path(self, cps):
+        docs = [_pod(i) for i in range(5)]
+        v = np.asarray(cps.evaluate_pipelined(docs, chunk=64))
+        assert np.array_equal(v, np.asarray(cps.evaluate(docs)))
+
+
+class TestChunkedFlatten:
+    def test_chunked_flatten_verdict_parity(self, cps, monkeypatch):
+        from kyverno_tpu.models.native_flatten import flatten_packed_chunks
+
+        # force multi-chunk even on single-core boxes — the point is the
+        # merge, not the wall clock
+        monkeypatch.setenv("KTPU_FLATTEN_WORKERS", "2")
+        docs = [_pod(i) for i in range(700)]
+        chunked = flatten_packed_chunks(cps.tensors, docs, chunk=256)
+        direct = cps.flatten_packed(docs)
+        assert chunked.n == direct.n
+        v_a = np.asarray(cps.evaluate_device(chunked))
+        v_b = np.asarray(cps.evaluate_device(direct))
+        assert np.array_equal(v_a, v_b)
+
+
+def _make_batcher(**kw):
+    kw.setdefault("dispatch_cost_init_s", 0.0)
+    kw.setdefault("oracle_cost_init_s", 1.0)
+    kw.setdefault("cold_flush_fallback", False)
+    kw.setdefault("result_cache_ttl_s", 0.0)
+    cache = PolicyCache()
+    # device-decidable policies only: a host-only rule would escalate
+    # every screen to ATTENTION and mask the memo-path assertions
+    for doc in POLICIES[:2]:
+        cache.add(doc)
+    return AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                            **kw), cache
+
+
+class TestBatcherPipeline:
+    def test_memoized_screen_matches_first_screen(self):
+        """Second screen of the same body is served through the row memo
+        (hit counter moves) and returns the identical status + rows."""
+        batcher, _ = _make_batcher()
+        try:
+            res = _pod(4)   # weight 28, grace 52s, image nginx:1.4 → CLEAN
+            first = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", res)
+            second = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                    "default", res)
+            assert first == second
+            assert first[0] == CLEAN
+            with batcher._lock:
+                hits = batcher.stats.get("flatten_cache_hit_rows", 0)
+                misses = batcher.stats.get("flatten_cache_miss_rows", 0)
+            assert hits >= 1
+            assert misses >= 1
+        finally:
+            batcher.stop()
+
+    def test_memoized_violation_still_flagged(self):
+        batcher, _ = _make_batcher()
+        try:
+            res = _pod(3)   # nginx:latest → ATTENTION both times
+            first = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", res)
+            second = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                    "default", res)
+            assert first[0] == ATTENTION and second[0] == ATTENTION
+            assert first[1] == second[1]
+        finally:
+            batcher.stop()
+
+    def test_kill_switch_screen_parity(self, monkeypatch):
+        """With the pipeline off the batcher must fall back to the plain
+        flatten + sync dispatch and still produce the same decisions."""
+        monkeypatch.setenv("KTPU_FLATTEN_PIPELINE", "0")
+        batcher, _ = _make_batcher()
+        try:
+            assert batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                  "default", _pod(4))[0] == CLEAN
+            assert batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                  "default", _pod(3))[0] == ATTENTION
+            with batcher._lock:
+                assert "flatten_cache_hit_rows" not in batcher.stats
+                assert "flatten_cache_miss_rows" not in batcher.stats
+        finally:
+            batcher.stop()
+
+    def test_recompile_invalidates_memoized_rows(self):
+        """Policy swap that MOVES the path dictionary: rows memoized under
+        the old tensors must not splice into the new set's batches. The
+        new policy flags what the old one cleared."""
+        batcher, cache = _make_batcher()
+        try:
+            res = _pod(4)   # weight 28: clean under <=100
+            assert batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                  "default", res)[0] == CLEAN
+            strict = _policy("weight-floor",
+                             pattern={"spec": {"weight": ">100",
+                                               "tier": "gold"}})
+            cache.add(strict)
+            status, rows = batcher.screen(PolicyType.VALIDATE_ENFORCE,
+                                          "Pod", "default", res)
+            assert status == ATTENTION
+            assert any(p == "weight-floor" and v != Verdict.PASS
+                       for p, _, v, _ in rows)
+        finally:
+            batcher.stop()
+
+    def test_warmup_seeds_memo_and_shapes(self):
+        batcher, cache = _make_batcher()
+        try:
+            res = _pod(7)
+            batcher.warmup(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                           res, batch_sizes=(1, 2))
+            cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                 "default")
+            with batcher._lock:
+                assert batcher._seen_shapes.get(cps)
+            if pipeline_enabled():
+                assert len(batcher._row_cache) >= 1
+        finally:
+            batcher.stop()
+
+
+class TestScanPipeline:
+    def test_background_scan_parity(self, monkeypatch):
+        from kyverno_tpu.parallel.mesh import DEFAULT_CHUNK
+        from kyverno_tpu.runtime.background import BackgroundScanner
+
+        n = DEFAULT_CHUNK + 64    # force the chunked/pipelined branch
+        resources = [_pod(i) for i in range(n)]
+        pipe = BackgroundScanner(POLICIES).scan(resources)
+        monkeypatch.setenv("KTPU_FLATTEN_PIPELINE", "0")
+        serial = BackgroundScanner(POLICIES).scan(resources)
+        assert pipe.resources_scanned == serial.resources_scanned == n
+        assert pipe.rules_evaluated == serial.rules_evaluated
+        assert pipe.violations == serial.violations
+        pipe_rows = sorted(
+            (r.policy_response.policy.name, r.policy_response.resource.name,
+             tuple((x.name, x.status) for x in r.policy_response.rules))
+            for r in pipe.responses)
+        serial_rows = sorted(
+            (r.policy_response.policy.name, r.policy_response.resource.name,
+             tuple((x.name, x.status) for x in r.policy_response.rules))
+            for r in serial.responses)
+        assert pipe_rows == serial_rows

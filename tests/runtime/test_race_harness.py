@@ -400,3 +400,82 @@ class TestReportWriterRaces:
             assert len(keys) <= 7
         finally:
             gen.stop()
+
+
+class TestFlattenPipelineRaces:
+    def test_concurrent_memoized_flushes_vs_policy_swap(self):
+        """The pipelined flush path under fire: concurrent screens whose
+        windows splice memoized flatten rows, racing policy-cache swaps
+        that MOVE the path dictionary (new tensor fingerprint) mid-burst.
+        Invariant: a pod violating the always-present policy is never
+        screened CLEAN — a stale memo row spliced across a recompile
+        would be exactly that failure. Post-churn probes then prove both
+        directions of invalidation: memoized-clean rows stay clean once
+        the structurally-different policy is gone, and re-adding it flags
+        the same memoized body."""
+        from kyverno_tpu.runtime.batch import ATTENTION, CLEAN, AdmissionBatcher
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        cache = PolicyCache()
+        cache.add(_policy("block-latest"))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0)
+
+        def pod(i, bad):
+            # small name space: repeated bodies → real memo hits
+            return {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"p{i % 4}", "namespace": "default"},
+                    "spec": {"containers": [{
+                        "name": "c",
+                        "image": "nginx:latest" if bad else "nginx:1.21"}]}}
+
+        def screen(i):
+            bad = i % 2 == 1
+            status, _ = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                       "default", pod(i, bad))
+            if bad:
+                # block-latest exists in EVERY policy generation; a CLEAN
+                # here means a verdict crossed generations or a stale
+                # memo row spliced into a fresh batch
+                assert status != CLEAN
+
+        def churn(i):
+            # structurally different pattern → the combined tensor set's
+            # path dictionary (and fingerprint) changes on every swap,
+            # churning the memo key space under the screen workers
+            extra = _policy(f"extra-{i % 2}", image_pat="!*:dev")
+            cache.add(extra)
+            cache.remove(extra)
+
+        try:
+            errors = race([screen, screen, screen, churn], duration_s=1.5)
+        finally:
+            batcher.stop()
+        assert not errors, errors[:3]
+
+        # quiescent probes on a fresh batcher sharing the same cache:
+        # the swap policy is gone, so a ':dev' body memoized clean (or
+        # flagged) under some mid-burst generation must screen CLEAN now
+        probe = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                 dispatch_cost_init_s=0.0,
+                                 oracle_cost_init_s=1.0,
+                                 cold_flush_fallback=False,
+                                 result_cache_ttl_s=0.0)
+        try:
+            dev = {"apiVersion": "v1", "kind": "Pod",
+                   "metadata": {"name": "probe", "namespace": "default"},
+                   "spec": {"containers": [{"name": "c",
+                                            "image": "nginx:dev"}]}}
+            assert probe.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                "default", dev)[0] == CLEAN
+            # now re-add the ':dev' blocker: the row just memoized CLEAN
+            # lives under the OLD fingerprint, so the same body must be
+            # re-flattened and flagged under the new tensor set
+            cache.add(_policy("block-dev", image_pat="!*:dev"))
+            assert probe.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                "default", dev)[0] == ATTENTION
+        finally:
+            probe.stop()
