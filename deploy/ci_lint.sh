@@ -25,6 +25,9 @@ python -m kyverno_tpu.cli lint --fail-on error "${@:-tests/policies}" || rc=1
 echo "== pipeline parity smoke (serial vs pipelined dataflow)"
 JAX_PLATFORMS=cpu python deploy/pipeline_smoke.py || rc=1
 
+echo "== policy-storm smoke (incremental splice parity + kill switch)"
+JAX_PLATFORMS=cpu python deploy/storm_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

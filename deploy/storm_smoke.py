@@ -1,0 +1,149 @@
+"""Policy-update-storm smoke for CI (deploy/ci_lint.sh).
+
+Drives a 4-policy set through an update storm on the incremental
+compiler and fails on any divergence from the from-scratch compile:
+
+1. splice parity — after each single-policy update the segmented
+   assembly (only the touched segment recompiled, rebased offsets,
+   pow2 rule bucket) must score bit-identically to a monolithic
+   ``CompiledPolicySet`` of the same policies;
+2. memo survival — flatten rows memoized before the storm must
+   epoch-refresh and splice to the same verdicts as fresh flattens;
+3. kill switch — ``KTPU_INCREMENTAL=0`` must restore the legacy
+   monolithic path exactly (same fingerprint, same verdicts).
+
+Fast by construction: CPU backend, 4 policies, a handful of rows.
+Exit 0 = parity, 1 = divergence.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "grace": f"{(i * 13) % 400}s"}}
+
+
+def main() -> int:
+    import numpy as np
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.models import CompiledPolicySet
+    from kyverno_tpu.models.engine import IncrementalCompiler
+    from kyverno_tpu.models.flatten import (
+        MemoRow,
+        refresh_packed_row,
+        splice_packed_rows,
+        split_packed_rows,
+    )
+
+    def policy(name, pattern):
+        return load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": "m", "pattern": pattern},
+            }]},
+        })
+
+    lib = {
+        "no-latest": policy("no-latest",
+                            {"spec": {"containers": [{"image": "!*:latest"}]}}),
+        "weight-cap": policy("weight-cap", {"spec": {"weight": "<=100"}}),
+        "grace-cap": policy("grace-cap", {"spec": {"grace": "<1h"}}),
+        "named": policy("named", {"metadata": {"name": "pod-?*"}}),
+    }
+    docs = [_pod(i) for i in range(48)]
+    inc = IncrementalCompiler()
+    cps0 = inc.refresh(list(lib.values()))
+    memos = [MemoRow(row=r, n_paths=cps0.tensors.n_paths,
+                     epoch=cps0.tensors.dict_epoch)
+             for r in split_packed_rows(cps0.flatten_packed(docs))]
+
+    # the storm: three single-policy updates, each appending paths
+    storm = [
+        ("weight-cap", {"spec": {"weight": "<=90",
+                                 "tier": {"class": "?*"}}}),
+        ("named", {"metadata": {"annotations": {"team": "?*"}}}),
+        ("no-latest", {"spec": {"containers": [{"image": "!*:latest",
+                                                "name": "c?*"}]}}),
+    ]
+    for step, (name, pattern) in enumerate(storm):
+        lib[name] = policy(name, pattern)
+        policies = list(lib.values())
+        cps = inc.refresh(policies)
+        if inc.last_refresh["recompiled"] != 1:
+            print(f"storm_smoke: step {step} recompiled "
+                  f"{inc.last_refresh['recompiled']} segments, want 1",
+                  file=sys.stderr)
+            return 1
+        fresh = CompiledPolicySet(policies)
+        want = np.asarray(fresh.evaluate_device(fresh.flatten_packed(docs)))
+        got = np.asarray(cps.evaluate_device(cps.flatten_packed(docs)))
+        if not np.array_equal(got, want):
+            print(f"storm_smoke: splice DIVERGENCE at step {step}",
+                  file=sys.stderr)
+            return 1
+
+        survived = 0
+        refreshed = []
+        for m, d in zip(memos, docs):
+            m2, _ext = refresh_packed_row(m, d, cps.tensors)
+            if m2 is None:
+                print(f"storm_smoke: memo row lost at step {step}",
+                      file=sys.stderr)
+                return 1
+            survived += 1
+            refreshed.append(m2)
+        memos = refreshed
+        spliced = np.asarray(cps.evaluate_device(
+            splice_packed_rows([m.row for m in memos])))
+        if not np.array_equal(spliced, want):
+            print(f"storm_smoke: memo-splice DIVERGENCE at step {step}",
+                  file=sys.stderr)
+            return 1
+
+    # kill switch: the legacy monolithic path, bit for bit
+    os.environ["KTPU_INCREMENTAL"] = "0"
+    try:
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        cache = PolicyCache()
+        for p in lib.values():
+            cache.add(p)
+        legacy = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                "default")
+        t = legacy.tensors
+        ref = CompiledPolicySet(legacy.policies)
+        if t.dict_base is not None or t.fingerprint != ref.tensors.fingerprint:
+            print("storm_smoke: kill switch did not restore the "
+                  "monolithic compile", file=sys.stderr)
+            return 1
+        got = np.asarray(legacy.evaluate_device(legacy.flatten_packed(docs)))
+        want = np.asarray(ref.evaluate_device(ref.flatten_packed(docs)))
+        if not np.array_equal(got, want):
+            print("storm_smoke: kill-switch verdict DIVERGENCE",
+                  file=sys.stderr)
+            return 1
+    finally:
+        del os.environ["KTPU_INCREMENTAL"]
+
+    print(f"storm_smoke: OK ({len(docs)} rows x {len(lib)} policies, "
+          f"{len(storm)} single-segment updates, memo survival "
+          f"{len(memos)}/{len(docs)}, kill switch exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

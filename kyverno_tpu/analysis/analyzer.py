@@ -54,9 +54,36 @@ def analyze_policies(policies, include_tensors: bool = True,
 
     if include_tensors and all_irs:
         tensor_diags = check_tensors(compile_tensors(all_irs))
+        tensor_diags += _check_incremental(policies)
         report.diagnostics += [d for d in tensor_diags
                                if d.code not in global_suppress]
     return report
+
+
+def _check_incremental(policies) -> list[Diagnostic]:
+    """Lint the *segmented* assembly too: with KTPU_INCREMENTAL on the
+    runtime serves tensors built by per-policy segment splice (rebased
+    offsets, bucket-padded rule axis), not the monolithic compile — so
+    ``kyverno-tpu lint`` must validate that set, including the KT304
+    splice receipts. Still jax-free (pure compiler + numpy)."""
+    from ..models.compiler import (
+        TensorDictionary,
+        assemble_tensors,
+        compile_segment,
+        incremental_enabled,
+    )
+
+    if not incremental_enabled():
+        return []
+    dictionary = TensorDictionary(persistent=True)
+    segs = []
+    for policy in policies:
+        rules = _validate_rules(policy)
+        seg_irs = [compile_rule_ir(policy, rule, li)
+                   for li, rule in enumerate(rules)]
+        segs.append(compile_segment(seg_irs, dictionary, name=policy.name))
+    return check_tensors(assemble_tensors(segs, dictionary,
+                                          rule_bucket=True))
 
 
 def lint_batch(batch, orig_n: int | None = None,

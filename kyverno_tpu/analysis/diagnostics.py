@@ -43,6 +43,7 @@ CODES: dict[str, tuple[Severity, str]] = {
     "KT301": (Severity.ERROR, "tensor dtype invariant violated"),
     "KT302": (Severity.ERROR, "tensor index out of range"),
     "KT303": (Severity.ERROR, "tensor geometry invariant violated"),
+    "KT304": (Severity.ERROR, "segment splice invariant violated"),
     "KT311": (Severity.ERROR, "batch interner index out of range"),
     "KT312": (Severity.ERROR, "batch lane invariant violated"),
     "KT313": (Severity.ERROR, "padding-bucket invariant violated"),

@@ -115,6 +115,104 @@ def check_tensors(t: PolicyTensors) -> list[Diagnostic]:
             f"MAX_SEGMENTS={MAX_SEGMENTS} (first: "
             f"{too_deep[0].replace(SEP, '.')!r})",
             component="tensors.paths"))
+    out += check_segments(t)
+    return out
+
+
+def _span_bound(name: str, arr, seg: str, lo: int, hi: int,
+                sentinel: int | None = None) -> list[Diagnostic]:
+    """A segment's slice of an index column must stay inside that
+    segment's own rebased span."""
+    a = np.asarray(arr)
+    if a.size == 0:
+        return []
+    bad = (a < lo) | (a >= hi)
+    if sentinel is not None:
+        bad &= a != sentinel
+    if not bad.any():
+        return []
+    return [make(
+        "KT304",
+        f"{name}: {int(bad.sum())} entries of segment {seg!r} escape its "
+        f"span [{lo}, {hi}) (first offender {int(a[bad].flat[0])}); a "
+        "corrupted splice rebased this column against the wrong base",
+        component=f"tensors.{name}")]
+
+
+def check_segments(t: PolicyTensors) -> list[Diagnostic]:
+    """Splice receipts (KT304): after an incremental assembly the
+    per-policy SegmentSpans must exactly tile every rebased axis, the
+    logical rule count must fit the (possibly bucket-padded) rule axis,
+    and every cross-referencing id inside a segment's rows must stay in
+    that segment's own span. A violation means ``assemble_tensors``
+    spliced a stale or mis-rebased segment — verdict columns silently
+    read another policy's rows."""
+    segs = list(getattr(t, "segments", None) or [])
+    if not segs:
+        return []
+    out: list[Diagnostic] = []
+    n_live = t.n_rules_live
+    if n_live > t.n_rules:
+        out.append(make(
+            "KT304", f"n_rules_logical {n_live} exceeds padded rule axis "
+            f"{t.n_rules}; verdict slicing would read out of bounds",
+            component="tensors.n_rules_logical"))
+    axes = {
+        "chk": len(t.chk_op), "alt": t.n_alts, "group": t.n_groups,
+        "gate": t.n_gates, "aux": len(t.ax_op), "axg": t.n_aux_groups,
+        "axf": t.n_aux_filters,
+    }
+    for axis, total in axes.items():
+        pos, ok = 0, True
+        for start, length in sorted(getattr(s, axis) for s in segs):
+            if start != pos:
+                ok = False
+                break
+            pos += length
+        if not ok or pos != total:
+            out.append(make(
+                "KT304", f"segment {axis} spans do not tile [0, {total}): "
+                "splice dropped or overlapped rows",
+                component=f"tensors.segments.{axis}"))
+    pos, ok = 0, True
+    for start, length in sorted((s.rule_base, s.n_rules) for s in segs):
+        if start != pos:
+            ok = False
+            break
+        pos += length
+    if not ok or pos != n_live:
+        out.append(make(
+            "KT304", f"segment rule spans do not tile [0, {n_live})",
+            component="tensors.segments.rule"))
+
+    for s in segs:
+        r = (s.rule_base, s.rule_base + s.n_rules)
+        alt = (s.alt[0], s.alt[0] + s.alt[1])
+        axg = (s.axg[0], s.axg[0] + s.axg[1])
+        axf = (s.axf[0], s.axf[0] + s.axf[1])
+        c0, cl = s.chk
+        out += _span_bound("chk_rule", t.chk_rule[c0:c0 + cl], s.name, *r)
+        out += _span_bound("chk_alt_gid", t.chk_alt_gid[c0:c0 + cl],
+                           s.name, *alt)
+        out += _span_bound("chk_group_gid", t.chk_group_gid[c0:c0 + cl],
+                           s.name, s.group[0], s.group[0] + s.group[1])
+        out += _span_bound("chk_gate", t.chk_gate[c0:c0 + cl], s.name,
+                           s.gate[0], s.gate[0] + s.gate[1], sentinel=-1)
+        g0, gl = s.group
+        out += _span_bound("group_alt", t.group_alt[g0:g0 + gl], s.name,
+                           *alt)
+        a0, al = s.alt
+        out += _span_bound("alt_rule", t.alt_rule[a0:a0 + al], s.name, *r)
+        x0, xl = s.aux
+        out += _span_bound("ax_rule", t.ax_rule[x0:x0 + xl], s.name, *r)
+        out += _span_bound("ax_group", t.ax_group[x0:x0 + xl], s.name,
+                           *axg)
+        out += _span_bound("axg_rule", t.axg_rule[axg[0]:axg[1]], s.name,
+                           *r)
+        out += _span_bound("axg_filt", t.axg_filt[axg[0]:axg[1]], s.name,
+                           *axf, sentinel=-1)
+        out += _span_bound("axf_rule", t.axf_rule[axf[0]:axf[1]], s.name,
+                           *r)
     return out
 
 

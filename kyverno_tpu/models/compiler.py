@@ -12,6 +12,17 @@ Produces the static, device-resident representation of a policy set:
 - per-rule kind sets for the legacy prefilter (host-lane rules only;
   device rules carry their full match program as aux rows)
 
+Compilation is *segmented*: each policy's rules compile into a
+self-contained :class:`PolicySegment` whose rule/alt/group/gate ids are
+local (base 0) but whose path/NFA/kind ids come from a shared append-only
+:class:`TensorDictionary`. ``assemble_tensors`` concatenates segments
+into one :class:`PolicyTensors`, rebasing the local ids — so a policy
+update recompiles one segment and splices it in while every other
+segment's rows (and every flatten-row memo keyed on the dictionary)
+survive byte-identical. ``compile_tensors`` is the one-shot form:
+a single segment over a throwaway dictionary, byte-identical to the
+pre-segmentation compiler.
+
 This is the ``policycache emits a precompiled policy tensor`` component of
 the north star (BASELINE.json) — the TPU analogue of
 /root/reference/pkg/policycache building its kind index at policy admission.
@@ -19,6 +30,8 @@ the north star (BASELINE.json) — the TPU analogue of
 
 from __future__ import annotations
 
+import os
+import uuid
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -42,6 +55,77 @@ from .ir import (
 NFA_STATES = 48
 STR_LEN = 64
 MAX_SEGMENTS = 12
+
+
+def incremental_enabled() -> bool:
+    """KTPU_INCREMENTAL=0 disables segment splicing, epoch-keyed memo
+    survival and rule-axis bucketing everywhere — every policy change
+    then rebuilds its population from scratch (the pre-storm behavior).
+    Read dynamically so tests can flip it per-case."""
+    return os.environ.get("KTPU_INCREMENTAL", "1") not in ("0", "false", "")
+
+
+class _Host(Exception):
+    """Raised inside segment compilation when a construct can't take the
+    device lane (oversized glob, non-ASCII pattern); the rule falls back
+    to host_only and compilation continues."""
+
+
+class TensorDictionary:
+    """Append-only path / glob-NFA / kind interner shared across segment
+    compiles of one policy population.
+
+    Ids are row indices, so append-only growth is the invariant that
+    makes incremental compilation safe: a segment compiled at epoch *e*
+    references the same rows at any epoch *e' >= e*, and a flatten-row
+    memo cut at epoch *e* stays a valid prefix of any later batch.
+    ``epoch`` counts appends to what the flatteners consume (paths and
+    kinds — NFA rows are eval-side only); ``base`` names the lineage
+    (uuid) when ``persistent`` so memo caches can key on it across
+    recompiles, and is None for throwaway one-shot compiles."""
+
+    def __init__(self, persistent: bool = False):
+        self.paths: list[str] = []
+        self.path_index: dict[str, int] = {}
+        self.nfa_rows: list = []
+        self.nfa_index: dict[tuple[str, bool], int] = {}
+        self.kind_index: dict[str, int] = {}
+        self.epoch = 0
+        self.base: str | None = uuid.uuid4().hex if persistent else None
+
+    def path_id(self, p: str) -> int:
+        if p not in self.path_index:
+            self.path_index[p] = len(self.paths)
+            self.paths.append(p)
+            self.epoch += 1
+        return self.path_index[p]
+
+    def nfa_id(self, pattern: str, literal: bool = False) -> int:
+        key = (pattern, literal)
+        if key in self.nfa_index:
+            return self.nfa_index[key]
+        row = _compile_glob(pattern, literal)
+        if row is None:
+            raise _Host(f"glob pattern not NFA-compilable: {pattern!r}")
+        self.nfa_index[key] = len(self.nfa_rows)
+        self.nfa_rows.append(row)
+        return self.nfa_index[key]
+
+    def kind_id(self, k: str) -> int:
+        if k not in self.kind_index:
+            self.kind_index[k] = len(self.kind_index)
+            self.epoch += 1
+        return self.kind_index[k]
+
+    def ensure_nonempty(self) -> None:
+        """A rule set whose device lane is pure gates (kind-only match, no
+        pattern paths — e.g. a mutate-gate screen) still needs a non-empty
+        path axis for the kernel's gathers; the sentinel is never
+        referenced by any check (and deliberately not interned, matching
+        the historical compiler)."""
+        if not self.paths:
+            self.paths.append("metadata")
+            self.epoch += 1
 
 
 @dataclass
@@ -142,9 +226,35 @@ class PolicyTensors:
     kind_index: dict[str, int]
     rules: list[RuleIR] = field(default_factory=list)
 
+    # -- incremental-compilation provenance (assemble_tensors) ----------
+    # lineage id of the shared TensorDictionary (None for one-shot
+    # compiles) and its append counter at assembly time; memo caches key
+    # on (memo_space, digest) and revalidate rows across epochs
+    dict_base: str | None = None
+    dict_epoch: int = 0
+    # true rule count when the rule axis is padded to a power-of-two
+    # bucket (rule-axis bucketing); -1 = unpadded (n_rules is logical)
+    n_rules_logical: int = -1
+    # SegmentSpan per assembled segment ([] for one-shot compiles)
+    segments: list = field(default_factory=list)
+
     @property
     def n_paths(self) -> int:
         return len(self.paths)
+
+    @property
+    def n_rules_live(self) -> int:
+        """Logical rule count: columns past this are inert bucket padding
+        (verdict NOT_APPLICABLE by construction) and are sliced off
+        before any verdict matrix reaches a caller."""
+        return self.n_rules if self.n_rules_logical < 0 else self.n_rules_logical
+
+    @property
+    def memo_space(self) -> str:
+        """Key space for flatten-row memos: the dictionary lineage when
+        compiled incrementally (stable across splices — rows revalidate
+        by epoch), else the content fingerprint (exact match only)."""
+        return self.dict_base if self.dict_base is not None else self.fingerprint
 
     @property
     def fingerprint(self) -> str:
@@ -202,40 +312,87 @@ _AUX_COL_NAMES = (
     "q", "s",
 )
 
+_CHK_COL_NAMES = (
+    "path", "op", "rule", "alt", "group", "gate", "guard", "is_gate",
+    "is_cond", "tracked", "exist", "nfa", "lo", "hi", "bool", "numfb",
+    "num_mode", "track_depth", "cond_depth",
+)
 
-def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
-    paths: list[str] = []
-    path_index: dict[str, int] = {}
+_RULE_FLAG_NAMES = (
+    "match_any", "has_match", "has_exclude", "exclude_all",
+    "has_precond", "precond_any", "is_deny", "deny_any",
+)
 
-    def path_id(p: str) -> int:
-        if p not in path_index:
-            path_index[p] = len(paths)
-            paths.append(p)
-        return path_index[p]
 
-    nfa_rows = []
-    nfa_index: dict[tuple[str, bool], int] = {}
+@dataclass(frozen=True)
+class SegmentSpan:
+    """Row ranges one assembled segment occupies inside a PolicyTensors —
+    the splice receipt the KT3xx invariant checks validate (a corrupted
+    rebase shows up as ids escaping their span)."""
 
-    class _Host(Exception):
-        pass
+    name: str
+    rule_base: int
+    n_rules: int
+    chk: tuple[int, int]                  # (start, length) in check rows
+    alt: tuple[int, int]
+    group: tuple[int, int]
+    gate: tuple[int, int]
+    aux: tuple[int, int]
+    axg: tuple[int, int]
+    axf: tuple[int, int]
 
-    def nfa_id(pattern: str, literal: bool = False) -> int:
-        key = (pattern, literal)
-        if key in nfa_index:
-            return nfa_index[key]
-        row = _compile_glob(pattern, literal)
-        if row is None:
-            raise _Host(f"glob pattern not NFA-compilable: {pattern!r}")
-        nfa_index[key] = len(nfa_rows)
-        nfa_rows.append(row)
-        return nfa_index[key]
 
-    kind_index: dict[str, int] = {}
+@dataclass
+class PolicySegment:
+    """One policy's compiled tensor rows, self-contained: rule / alt /
+    group / gate / aux-group / aux-filter ids are *local* (all bases 0)
+    while path / NFA / kind ids are *global* (interned into the shared
+    TensorDictionary). ``assemble_tensors`` rebases the local axes when
+    concatenating, so a segment compiled once splices unchanged into any
+    later assembly of its lineage."""
 
-    def kind_id(k: str) -> int:
-        if k not in kind_index:
-            kind_index[k] = len(kind_index)
-        return kind_index[k]
+    name: str
+    rule_irs: list[RuleIR]
+    n_rules: int
+    n_gates: int
+    dict_epoch: int                       # dictionary epoch after compile
+    chk: dict[str, list]
+    group_alt: list[int]
+    alt_rule: list[int]
+    aux: dict[str, list]
+    axg_negate: list
+    axg_klass: list
+    axg_rule: list
+    axg_any: list
+    axg_filt: list
+    axf_rule: list
+    axf_is_exclude: list
+    rule_flags: dict[str, np.ndarray]     # [n_rules] each, _RULE_FLAG_NAMES
+    kind_slots: list[list[int]]           # per local rule: kind id / -1('*')
+    rule_all_kinds: np.ndarray            # [n_rules] bool
+    rule_host_only: np.ndarray            # [n_rules] bool
+
+    @property
+    def n_alts(self) -> int:
+        return len(self.alt_rule)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.group_alt)
+
+
+def compile_segment(rule_irs: list[RuleIR], dictionary: TensorDictionary,
+                    name: str = "") -> PolicySegment:
+    """Compile one policy's RuleIRs into a self-contained segment.
+
+    ``rule_irs`` carry segment-local ``rule_index`` values (0..n-1);
+    global rule rows are assigned at assembly by adding the segment's
+    rule base. Dictionary ids (paths, NFAs, kinds) are appended to
+    ``dictionary`` and are final — append-only growth means they never
+    move under an already-compiled segment."""
+    path_id = dictionary.path_id
+    nfa_id = dictionary.nfa_id
+    kind_id = dictionary.kind_id
 
     # validate device-lane constraints that depend on tensor geometry
     for rule in rule_irs:
@@ -254,11 +411,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
                 rule.host_reason_code = EscalationReason.GEOMETRY.value
                 break
 
-    chk_cols: dict[str, list] = {k: [] for k in (
-        "path", "op", "rule", "alt", "group", "gate", "guard", "is_gate",
-        "is_cond", "tracked", "exist", "nfa", "lo", "hi", "bool", "numfb",
-        "num_mode", "track_depth", "cond_depth",
-    )}
+    chk_cols: dict[str, list] = {k: [] for k in _CHK_COL_NAMES}
     group_alt: list[int] = []
     alt_rule: list[int] = []
     n_gates_total = 0
@@ -273,14 +426,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
     axf_is_exclude: list[bool] = []
 
     n_rules = max((r.rule_index for r in rule_irs), default=-1) + 1
-    rule_match_any = np.zeros(n_rules, dtype=bool)
-    rule_has_match = np.zeros(n_rules, dtype=bool)
-    rule_has_exclude = np.zeros(n_rules, dtype=bool)
-    rule_exclude_all = np.zeros(n_rules, dtype=bool)
-    rule_has_precond = np.zeros(n_rules, dtype=bool)
-    rule_precond_any = np.zeros(n_rules, dtype=bool)
-    rule_is_deny = np.zeros(n_rules, dtype=bool)
-    rule_deny_any = np.zeros(n_rules, dtype=bool)
+    rule_flags = {k: np.zeros(n_rules, dtype=bool) for k in _RULE_FLAG_NAMES}
 
     for rule in rule_irs:
         if rule.host_only:
@@ -426,38 +572,172 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
             axf_rule.append(r_idx)
             axf_is_exclude.append(is_ex)
 
-        rule_match_any[rule.rule_index] = rule.match_any
-        rule_has_match[rule.rule_index] = rule.n_match_filters > 0
-        rule_has_exclude[rule.rule_index] = rule.n_exclude_filters > 0
-        rule_exclude_all[rule.rule_index] = rule.exclude_all
-        rule_has_precond[rule.rule_index] = rule.has_precond
-        rule_precond_any[rule.rule_index] = rule.precond_has_any
-        rule_is_deny[rule.rule_index] = rule.is_deny
-        rule_deny_any[rule.rule_index] = rule.deny_has_any
+        rule_flags["match_any"][rule.rule_index] = rule.match_any
+        rule_flags["has_match"][rule.rule_index] = rule.n_match_filters > 0
+        rule_flags["has_exclude"][rule.rule_index] = rule.n_exclude_filters > 0
+        rule_flags["exclude_all"][rule.rule_index] = rule.exclude_all
+        rule_flags["has_precond"][rule.rule_index] = rule.has_precond
+        rule_flags["precond_any"][rule.rule_index] = rule.precond_has_any
+        rule_flags["is_deny"][rule.rule_index] = rule.is_deny
+        rule_flags["deny_any"][rule.rule_index] = rule.deny_has_any
 
     # legacy kind prefilter (host-lane rules route to the oracle by kind)
-    kmax = max((len(r.kinds) for r in rule_irs), default=1) or 1
-    rule_kinds = np.full((n_rules, kmax), -1, dtype=np.int32)
+    kind_slots: list[list[int]] = [[] for _ in range(n_rules)]
     rule_all_kinds = np.zeros(n_rules, dtype=bool)
     rule_host = np.zeros(n_rules, dtype=bool)
     for rule in rule_irs:
         rule_host[rule.rule_index] = rule.host_only
-        for j, k in enumerate(rule.kinds):
+        slots = kind_slots[rule.rule_index]
+        for k in rule.kinds:
             if k == "*":
                 rule_all_kinds[rule.rule_index] = True
+                slots.append(-1)
             else:
                 # "Pod" matches "Pod" and "v1/Pod" style GVKs; store the
                 # title-cased bare kind (utils.go checkKind title match)
-                rule_kinds[rule.rule_index, j] = kind_id(
-                    _title_first(k.split("/")[-1]))
+                slots.append(kind_id(_title_first(k.split("/")[-1])))
 
-    if not paths:
-        # a rule set whose device lane is pure gates (kind-only match, no
-        # pattern paths — e.g. a mutate-gate screen) still needs a
-        # non-empty path axis for the kernel's gathers; the sentinel is
-        # never referenced by any check
-        paths.append("metadata")
+    return PolicySegment(
+        name=name,
+        rule_irs=rule_irs,
+        n_rules=n_rules,
+        n_gates=n_gates_total,
+        dict_epoch=dictionary.epoch,
+        chk=chk_cols,
+        group_alt=group_alt,
+        alt_rule=alt_rule,
+        aux=aux,
+        axg_negate=axg_negate,
+        axg_klass=axg_klass,
+        axg_rule=axg_rule,
+        axg_any=axg_any,
+        axg_filt=axg_filt,
+        axf_rule=axf_rule,
+        axf_is_exclude=axf_is_exclude,
+        rule_flags=rule_flags,
+        kind_slots=kind_slots,
+        rule_all_kinds=rule_all_kinds,
+        rule_host_only=rule_host,
+    )
 
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def assemble_tensors(segments: list[PolicySegment],
+                     dictionary: TensorDictionary,
+                     rule_bucket: bool = False) -> PolicyTensors:
+    """Concatenate compiled segments into one PolicyTensors, rebasing the
+    local rule/alt/group/gate/aux axes by running offsets. Dictionary ids
+    pass through untouched (they are global by construction).
+
+    ``rule_bucket`` pads the rule axis to the next power of two with
+    inert rules (no alts -> not covered -> NOT_APPLICABLE in ops/eval.py)
+    so single-policy churn tends to land in an already-compiled XLA
+    shape; ``n_rules_logical`` records the true count and verdict
+    consumers slice back to it."""
+    chk_cols: dict[str, list] = {k: [] for k in _CHK_COL_NAMES}
+    group_alt: list[int] = []
+    alt_rule: list[int] = []
+    aux: dict[str, list] = {k: [] for k in _AUX_COL_NAMES}
+    axg_negate: list[bool] = []
+    axg_klass: list[int] = []
+    axg_rule: list[int] = []
+    axg_any: list[bool] = []
+    axg_filt: list[int] = []
+    axf_rule: list[int] = []
+    axf_is_exclude: list[bool] = []
+    rule_irs: list[RuleIR] = []
+    spans: list[SegmentSpan] = []
+
+    rule_base = alt_base = group_base = gate_base = 0
+    axg_base = axf_base = 0
+    for seg in segments:
+        spans.append(SegmentSpan(
+            name=seg.name,
+            rule_base=rule_base,
+            n_rules=seg.n_rules,
+            chk=(len(chk_cols["rule"]), len(seg.chk["rule"])),
+            alt=(alt_base, seg.n_alts),
+            group=(group_base, seg.n_groups),
+            gate=(gate_base, seg.n_gates),
+            aux=(len(aux["rule"]), len(seg.aux["rule"])),
+            axg=(axg_base, len(seg.axg_negate)),
+            axf=(axf_base, len(seg.axf_rule)),
+        ))
+        for k in chk_cols:
+            src = seg.chk[k]
+            if k == "rule":
+                chk_cols[k].extend(v + rule_base for v in src)
+            elif k == "alt":
+                chk_cols[k].extend(v + alt_base for v in src)
+            elif k == "group":
+                chk_cols[k].extend(v + group_base for v in src)
+            elif k == "gate":
+                chk_cols[k].extend(
+                    v + gate_base if v >= 0 else -1 for v in src)
+            else:
+                chk_cols[k].extend(src)
+        alt_rule.extend(v + rule_base for v in seg.alt_rule)
+        group_alt.extend(v + alt_base for v in seg.group_alt)
+        for k in aux:
+            src = seg.aux[k]
+            if k == "rule":
+                aux[k].extend(v + rule_base for v in src)
+            elif k == "group":
+                aux[k].extend(v + axg_base for v in src)
+            else:
+                aux[k].extend(src)
+        axg_negate.extend(seg.axg_negate)
+        axg_klass.extend(seg.axg_klass)
+        axg_rule.extend(v + rule_base for v in seg.axg_rule)
+        axg_any.extend(seg.axg_any)
+        axg_filt.extend(v + axf_base if v >= 0 else -1 for v in seg.axg_filt)
+        axf_rule.extend(v + rule_base for v in seg.axf_rule)
+        axf_is_exclude.extend(seg.axf_is_exclude)
+        rule_irs.extend(seg.rule_irs)
+
+        rule_base += seg.n_rules
+        alt_base += seg.n_alts
+        group_base += seg.n_groups
+        gate_base += seg.n_gates
+        axg_base += len(seg.axg_negate)
+        axf_base += len(seg.axf_rule)
+
+    n_rules_logical = rule_base
+    n_rules = _next_pow2(n_rules_logical) if rule_bucket else n_rules_logical
+    pad = n_rules - n_rules_logical
+
+    rule_flag_arrs = {}
+    for key in _RULE_FLAG_NAMES:
+        parts = [seg.rule_flags[key] for seg in segments]
+        arr = (np.concatenate(parts) if parts
+               else np.zeros(0, dtype=bool))
+        if pad:
+            arr = np.concatenate([arr, np.zeros(pad, dtype=bool)])
+        rule_flag_arrs[key] = arr
+
+    kmax = max((len(s) for seg in segments for s in seg.kind_slots),
+               default=1) or 1
+    rule_kinds = np.full((n_rules, kmax), -1, dtype=np.int32)
+    rule_all_kinds = np.zeros(n_rules, dtype=bool)
+    rule_host = np.zeros(n_rules, dtype=bool)
+    i = 0
+    for seg in segments:
+        rule_all_kinds[i:i + seg.n_rules] = seg.rule_all_kinds
+        rule_host[i:i + seg.n_rules] = seg.rule_host_only
+        for slots in seg.kind_slots:
+            for j, kid in enumerate(slots):
+                rule_kinds[i, j] = kid
+            i += 1
+    i += pad  # pad rules: no kinds, not host, not '*'
+
+    dictionary.ensure_nonempty()
+    paths = list(dictionary.paths)
+    path_index = dict(dictionary.path_index)
+
+    nfa_rows = dictionary.nfa_rows
     if nfa_rows:
         nfa_char = np.stack([r[0] for r in nfa_rows])
         nfa_star = np.stack([r[1] for r in nfa_rows])
@@ -502,7 +782,7 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         n_alts=len(alt_rule),
         group_alt=np.array(group_alt, dtype=np.int32) if group_alt else np.zeros(0, np.int32),
         alt_rule=np.array(alt_rule, dtype=np.int32) if alt_rule else np.zeros(0, np.int32),
-        n_gates=n_gates_total,
+        n_gates=gate_base,
         ax_path=arr(aux, "path", np.int32),
         ax_plen=arr(aux, "plen", np.int8),
         ax_op=arr(aux, "op", np.int8),
@@ -536,14 +816,14 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         n_aux_filters=len(axf_rule),
         axf_rule=np.array(axf_rule, dtype=np.int32),
         axf_is_exclude=np.array(axf_is_exclude, dtype=bool),
-        rule_match_any=rule_match_any,
-        rule_has_match=rule_has_match,
-        rule_has_exclude=rule_has_exclude,
-        rule_exclude_all=rule_exclude_all,
-        rule_has_precond=rule_has_precond,
-        rule_precond_any=rule_precond_any,
-        rule_is_deny=rule_is_deny,
-        rule_deny_any=rule_deny_any,
+        rule_match_any=rule_flag_arrs["match_any"],
+        rule_has_match=rule_flag_arrs["has_match"],
+        rule_has_exclude=rule_flag_arrs["has_exclude"],
+        rule_exclude_all=rule_flag_arrs["exclude_all"],
+        rule_has_precond=rule_flag_arrs["has_precond"],
+        rule_precond_any=rule_flag_arrs["precond_any"],
+        rule_is_deny=rule_flag_arrs["is_deny"],
+        rule_deny_any=rule_flag_arrs["deny_any"],
         nfa_char=nfa_char,
         nfa_is_star=nfa_star,
         nfa_is_q=nfa_q,
@@ -552,6 +832,20 @@ def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
         rule_kind_ids=rule_kinds,
         rule_match_all_kinds=rule_all_kinds,
         rule_host_only=rule_host,
-        kind_index=kind_index,
+        kind_index=dict(dictionary.kind_index),
         rules=rule_irs,
+        dict_base=dictionary.base,
+        dict_epoch=dictionary.epoch,
+        n_rules_logical=n_rules_logical,
+        segments=spans,
     )
+
+
+def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:
+    """One-shot compile: a single segment over a throwaway dictionary.
+    Byte-identical output to the pre-segmentation compiler — the append
+    order through the dictionary and the assembly of exactly one segment
+    (all rebase offsets 0) reproduce the historical row layout."""
+    dictionary = TensorDictionary()
+    seg = compile_segment(rule_irs, dictionary)
+    return assemble_tensors([seg], dictionary)

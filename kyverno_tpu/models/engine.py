@@ -17,7 +17,13 @@ from ..engine.context import Context
 from ..engine.policy_context import PolicyContext
 from ..engine.response import RuleStatus
 from ..engine.validation import validate as oracle_validate
-from .compiler import PolicyTensors, compile_tensors
+from .compiler import (
+    PolicyTensors,
+    TensorDictionary,
+    assemble_tensors,
+    compile_segment,
+    compile_tensors,
+)
 from .flatten import FlatBatch
 from .ir import compile_rule_ir
 
@@ -54,15 +60,19 @@ class AsyncVerdicts:
     np.array transfer is the synchronization point) and caches it, so
     repeated gets don't re-transfer."""
 
-    __slots__ = ("_out", "_verdicts")
+    __slots__ = ("_out", "_verdicts", "_n_live")
 
-    def __init__(self, out):
+    def __init__(self, out, n_live: int | None = None):
         self._out = out
+        self._n_live = n_live
         self._verdicts: np.ndarray | None = None
 
     def get(self) -> np.ndarray:
         if self._verdicts is None:
-            self._verdicts = np.array(self._out)
+            v = np.array(self._out)
+            if self._n_live is not None and v.shape[1] != self._n_live:
+                v = v[:, :self._n_live]
+            self._verdicts = v
             self._out = None
         return self._verdicts
 
@@ -75,20 +85,26 @@ class AsyncVerdicts:
 
 
 class CompiledPolicySet:
-    def __init__(self, policies: list):
+    def __init__(self, policies: list, _parts: tuple | None = None):
+        """``_parts`` — ``(rule_refs, rule_irs, tensors)`` from an
+        incremental assembly (IncrementalCompiler.refresh); the default
+        path compiles everything from scratch."""
         self.policies = list(policies)
-        self.rule_refs: list[RuleRef] = []
-        rule_irs = []
-        idx = 0
-        for policy in self.policies:
-            for rule in policy.spec.rules:
-                if not rule.has_validate():
-                    continue
-                self.rule_refs.append(RuleRef(policy, rule, idx))
-                rule_irs.append(compile_rule_ir(policy, rule, idx))
-                idx += 1
-        self.rule_irs = rule_irs
-        self.tensors: PolicyTensors = compile_tensors(rule_irs)
+        if _parts is not None:
+            self.rule_refs, self.rule_irs, self.tensors = _parts
+        else:
+            self.rule_refs: list[RuleRef] = []
+            rule_irs = []
+            idx = 0
+            for policy in self.policies:
+                for rule in policy.spec.rules:
+                    if not rule.has_validate():
+                        continue
+                    self.rule_refs.append(RuleRef(policy, rule, idx))
+                    rule_irs.append(compile_rule_ir(policy, rule, idx))
+                    idx += 1
+            self.rule_irs = rule_irs
+            self.tensors: PolicyTensors = compile_tensors(rule_irs)
         self._eval_fn = None
         self._blob_eval_fn = None
         import threading
@@ -146,7 +162,11 @@ class CompiledPolicySet:
         transfer form either way."""
         blob, shp = batch.packed_blob()
         out = self.blob_eval_fn(blob, *shp)
-        return np.array(out)
+        verdicts = np.array(out)
+        live = self.tensors.n_rules_live
+        if verdicts.shape[1] != live:
+            verdicts = verdicts[:, :live]   # drop inert rule-bucket padding
+        return verdicts
 
     def evaluate_device_async(self, batch) -> "AsyncVerdicts":
         """Dispatch the device eval WITHOUT blocking on the result.
@@ -158,7 +178,8 @@ class CompiledPolicySet:
         evaluate_pipelined) flatten the NEXT window between dispatch and
         get, which is where ``overlap_s_saved`` comes from."""
         blob, shp = batch.packed_blob()
-        return AsyncVerdicts(self.blob_eval_fn(blob, *shp))
+        return AsyncVerdicts(self.blob_eval_fn(blob, *shp),
+                             n_live=self.tensors.n_rules_live)
 
     # ------------------------------------------------------------ full
 
@@ -334,3 +355,141 @@ class CompiledPolicySet:
 
 def compile_policies(policies: list) -> CompiledPolicySet:
     return CompiledPolicySet(policies)
+
+
+def _validate_rules(policy) -> list:
+    return [r for r in policy.spec.rules if r.has_validate()]
+
+
+class IncrementalCompiler:
+    """Per-population segmented compiler — the policy-update-storm path.
+
+    Keeps one compiled :class:`~.compiler.PolicySegment` per policy plus
+    the shared append-only :class:`~.compiler.TensorDictionary`; on
+    churn, only segments whose policy *object* changed recompile, and
+    ``assemble_tensors`` splices all segments (rebased offsets) into a
+    fresh PolicyTensors. Because the dictionary only appends, unchanged
+    segments keep their path/NFA/kind ids and flatten-row memos keyed on
+    ``(dict_base, digest)`` revalidate by epoch instead of evicting.
+
+    ``rule_bucket=True`` pads the rule axis to power-of-two buckets so
+    repeated single-policy updates tend to reuse an already-XLA-compiled
+    eval geometry (verdicts are sliced back to ``n_rules_logical``).
+
+    Not thread-safe on its own; PolicyCache serializes access under its
+    lock, and standalone users (BackgroundScanner) drive it from one
+    thread."""
+
+    def __init__(self, rule_bucket: bool = True):
+        self.dictionary = TensorDictionary(persistent=True)
+        self.rule_bucket = rule_bucket
+        # policy key -> (id(policy object), PolicySegment)
+        self._segments: dict[str, tuple[int, object]] = {}
+        self._last: CompiledPolicySet | None = None
+        self._last_sig: tuple | None = None
+        self.stats = {"refreshes": 0, "segments_reused": 0,
+                      "segments_recompiled": 0, "segments_dropped": 0}
+        self.last_refresh: dict = {}
+
+    @staticmethod
+    def _policy_key(policy) -> str:
+        ns = getattr(policy, "namespace", "") or ""
+        return f"{ns}/{policy.name}" if ns else policy.name
+
+    def refresh(self, policies: list) -> CompiledPolicySet:
+        """Compiled set for ``policies`` (in order), recompiling only the
+        segments whose policy object is new or replaced. When nothing at
+        all changed, the previous CompiledPolicySet comes back as-is —
+        its cached eval_fn (and any XLA executable behind it) survives
+        churn in *other* populations."""
+        policies = list(policies)
+        sig = tuple(id(p) for p in policies)
+        self.stats["refreshes"] += 1
+        if self._last is not None and sig == self._last_sig:
+            self.stats["segments_reused"] += len(policies)
+            self.last_refresh = {"reused": len(policies), "recompiled": 0,
+                                 "dropped": 0, "unchanged": True,
+                                 "dict_epoch": self.dictionary.epoch,
+                                 "recompiled_keys": [], "dropped_keys": []}
+            return self._last
+
+        segs = []
+        rule_refs: list[RuleRef] = []
+        rule_irs = []
+        live_keys = set()
+        idx = 0
+        reused = 0
+        recompiled_keys: list[str] = []
+        for policy in policies:
+            key = self._policy_key(policy)
+            live_keys.add(key)
+            cached = self._segments.get(key)
+            if cached is not None and cached[0] == id(policy):
+                seg = cached[1]
+                reused += 1
+            else:
+                rules = _validate_rules(policy)
+                seg_irs = [compile_rule_ir(policy, rule, li)
+                           for li, rule in enumerate(rules)]
+                seg = compile_segment(seg_irs, self.dictionary, name=key)
+                self._segments[key] = (id(policy), seg)
+                recompiled_keys.append(key)
+            segs.append(seg)
+            for rule in _validate_rules(policy):
+                rule_refs.append(RuleRef(policy, rule, idx))
+                idx += 1
+            rule_irs.extend(seg.rule_irs)
+
+        dropped = [k for k in self._segments if k not in live_keys]
+        for k in dropped:
+            del self._segments[k]
+
+        tensors = assemble_tensors(segs, self.dictionary,
+                                   rule_bucket=self.rule_bucket)
+        cps = CompiledPolicySet(policies,
+                                _parts=(rule_refs, rule_irs, tensors))
+        self.stats["segments_reused"] += reused
+        self.stats["segments_recompiled"] += len(recompiled_keys)
+        self.stats["segments_dropped"] += len(dropped)
+        self.last_refresh = {"reused": reused,
+                             "recompiled": len(recompiled_keys),
+                             "dropped": len(dropped), "unchanged": False,
+                             "dict_epoch": tensors.dict_epoch,
+                             "recompiled_keys": recompiled_keys,
+                             "dropped_keys": dropped}
+        self._last = cps
+        self._last_sig = sig
+        return cps
+
+    def subset(self, policies: list) -> CompiledPolicySet:
+        """Compiled set over a *subset* of the population, assembled from
+        the same dictionary and segment cache. Its tensor set snapshots
+        the full path dictionary, so flatten rows memoized against the
+        full population splice into this one unchanged — the delta
+        scanner evaluates only the changed policies' rule columns against
+        already-flattened resources this way. Does not disturb the cached
+        full-set compile."""
+        segs = []
+        rule_refs: list[RuleRef] = []
+        rule_irs = []
+        idx = 0
+        for policy in policies:
+            key = self._policy_key(policy)
+            cached = self._segments.get(key)
+            if cached is not None and cached[0] == id(policy):
+                seg = cached[1]
+            else:
+                rules = _validate_rules(policy)
+                seg_irs = [compile_rule_ir(policy, rule, li)
+                           for li, rule in enumerate(rules)]
+                seg = compile_segment(seg_irs, self.dictionary, name=key)
+                self._segments[key] = (id(policy), seg)
+            segs.append(seg)
+            for rule in _validate_rules(policy):
+                rule_refs.append(RuleRef(policy, rule, idx))
+                idx += 1
+            rule_irs.extend(seg.rule_irs)
+        tensors = assemble_tensors(segs, self.dictionary,
+                                   rule_bucket=self.rule_bucket)
+        return CompiledPolicySet(list(policies),
+                                 _parts=(rule_refs, rule_irs, tensors))

@@ -72,6 +72,20 @@ PACKED_BATCH_ARRAYS = ("cells", "bmeta")
 PACKED_DICT_ARRAYS = ("str_bytes", "dictv")
 ELEM0_CAP = 254  # largest representable first-element index
 
+# Shared pad fill-value table for EVERY batch-padding site (bucket padding
+# here, mesh-multiple padding in parallel/mesh.py). Lanes that encode ids
+# as row indices pad with -1 ("no entry"); everything else pads with the
+# natural zero (dead slot / not live). Deriving both paths from one table
+# is what keeps a FlatBatch schema change from desynchronizing the mesh
+# pad from the bucket pad again.
+PAD_FILL = {"kind_id": -1, "str_id": -1, "elem0": -1}
+
+
+def pad_fill(name: str) -> int:
+    """Fill value for padding lane ``name`` (BATCH_ARRAYS / DICT_ARRAYS /
+    num_val); unlisted lanes zero-fill."""
+    return PAD_FILL.get(name, 0)
+
 
 def _assemble_blob(cells, bmeta, str_bytes, dictv):
     """Concatenate the packed arrays into one uint32 transfer buffer.
@@ -176,8 +190,7 @@ def pad_to_buckets(batch: FlatBatch) -> tuple["FlatBatch", int]:
         width = [(0, b2 - b)] + [(0, 0)] * (x.ndim - 1)
         if x.ndim == 3:
             width[2] = (0, e2 - e)
-        fill = -1 if name in ("kind_id", "str_id", "elem0") else 0
-        updates[name] = np.pad(x, width, constant_values=fill)
+        updates[name] = np.pad(x, width, constant_values=pad_fill(name))
     for name in DICT_ARRAYS:
         x = getattr(batch, name)
         width = [(0, v2 - v)] + [(0, 0)] * (x.ndim - 1)
@@ -418,6 +431,134 @@ class PackedRow:
     @property
     def nbytes(self) -> int:
         return self.cells.nbytes + self.str_bytes.nbytes + self.dictv.nbytes
+
+
+@dataclass
+class MemoRow:
+    """Epoch-keyed flatten-row memo entry: a PackedRow plus the dictionary
+    coordinates it was flattened at. Rows compiled at epoch *e* over
+    ``n_paths`` paths remain spliceable at any epoch *e' >= e* of the same
+    lineage because the dictionary only appends — the row is a valid
+    prefix, and :func:`refresh_packed_row` flattens just the appended
+    paths and concatenates. This is what lets a policy edit keep the
+    flatten work for every cached resource instead of evicting it."""
+
+    row: PackedRow
+    n_paths: int              # path-dictionary length at flatten time
+    epoch: int                # TensorDictionary.epoch at flatten time
+
+
+class _PathSlice:
+    """Minimal tensors view for :func:`flatten_batch`: the appended tail
+    of the path dictionary plus the (full, append-only) kind index."""
+
+    __slots__ = ("paths", "kind_index")
+
+    def __init__(self, paths: list[str], kind_index: dict[str, int]):
+        self.paths = paths
+        self.kind_index = kind_index
+
+    @property
+    def n_paths(self) -> int:
+        return len(self.paths)
+
+
+def _extend_row(row: PackedRow, delta: PackedRow) -> PackedRow:
+    """Concatenate a row's cells with a delta-flattened tail along the
+    path axis, re-interning the delta's private string table into the
+    row's (same (bytes, length) key + OR-merge as splice_packed_rows).
+    The delta's bmeta wins the kind bits (computed against the current
+    kind index) and ORs its host flag — host conditions are per-slot ORs,
+    so the union over path subsets equals the full-flatten flag."""
+    p0, e0 = int(row.cells.shape[0]), int(row.cells.shape[1])
+    p1, e1 = int(delta.cells.shape[0]), int(delta.cells.shape[1])
+    E = max(e0, e1)
+    cells = np.zeros((p0 + p1, E, 2), dtype=np.uint32)
+    cells[:p0, :e0] = row.cells
+
+    index: dict[tuple[bytes, int], int] = {}
+    v0 = int(row.dictv.shape[0])
+    sb_rows = [row.str_bytes[i] for i in range(v0)]
+    dv_rows = [row.dictv[i].copy() for i in range(v0)]
+    for i in range(v0):
+        index[(row.str_bytes[i].tobytes(), int(row.dictv[i, 4] & 0x7F))] = i
+    v1 = int(delta.dictv.shape[0])
+    lut = np.zeros(v1 + 1, dtype=np.uint32)
+    for i in range(v1):
+        key = (delta.str_bytes[i].tobytes(), int(delta.dictv[i, 4] & 0x7F))
+        j = index.get(key)
+        if j is None:
+            j = len(sb_rows)
+            index[key] = j
+            sb_rows.append(delta.str_bytes[i])
+            dv_rows.append(delta.dictv[i].copy())
+        else:
+            dv_rows[j] |= delta.dictv[i]
+        lut[i + 1] = j + 1
+    cells[p0:, :e1, 0] = lut[delta.cells[..., 0]]
+    cells[p0:, :e1, 1] = delta.cells[..., 1]
+
+    old_host = (row.bmeta >> 16) & 1
+    old_live = (row.bmeta >> 17) & 1
+    bmeta = int((delta.bmeta & 0x1FFFF) | ((old_host | old_live << 1) << 16))
+    if sb_rows:
+        str_bytes = np.stack(sb_rows).astype(np.uint8)
+        dictv = np.stack(dv_rows).astype(np.uint32)
+    else:
+        str_bytes = np.zeros((0, STR_LEN), dtype=np.uint8)
+        dictv = np.zeros((0, 5), dtype=np.uint32)
+    return PackedRow(cells=np.ascontiguousarray(cells), bmeta=bmeta,
+                     str_bytes=str_bytes, dictv=dictv)
+
+
+def flatten_one_row(resource: dict, tensors, request: dict | None = None,
+                    max_slots: int = 16) -> PackedRow:
+    """Flatten one resource against ``tensors`` (any object with paths /
+    kind_index / n_paths) straight to a PackedRow — the pure-Python
+    single-row path used by memo refresh and the delta scanner."""
+    fb = flatten_batch([resource], tensors, max_slots=max_slots,
+                       requests=[request] if request is not None else None)
+    cells, bmeta, str_bytes, dictv = pack_batch(fb)
+    return split_packed_rows(PackedBatch(
+        n=1, e=fb.e, cells=cells, bmeta=bmeta,
+        str_bytes=str_bytes, dictv=dictv))[0]
+
+
+def refresh_packed_row(memo: MemoRow, resource: dict,
+                       tensors: PolicyTensors,
+                       request: dict | None = None) -> tuple[MemoRow | None, bool]:
+    """Revalidate a memoized flatten row against the current tensor set
+    of its lineage. Returns ``(memo_row, extended)``:
+
+    - exact epoch/path match -> the memo unchanged, ``extended=False``;
+    - dictionary appended since the row was cut -> flatten only the
+      appended paths, concatenate, recompute the kind bits against the
+      current kind index, return the refreshed entry with
+      ``extended=True`` (still a survival — the per-path work for the old
+      prefix was not redone);
+    - the memo is from a *longer* dictionary (foreign lineage, or a
+      lineage reset) -> ``(None, False)``: caller re-flattens."""
+    n_new = tensors.n_paths
+    if memo.epoch == tensors.dict_epoch and memo.n_paths == n_new:
+        return memo, False
+    if memo.n_paths > n_new:
+        return None, False
+    row = memo.row
+    if n_new > memo.n_paths:
+        delta = flatten_one_row(
+            resource,
+            _PathSlice(tensors.paths[memo.n_paths:], tensors.kind_index),
+            request=request)
+        row = _extend_row(row, delta)
+    else:
+        # only the kind index appended: recompute the kind bits (the id
+        # of a previously-unknown kind may exist now); host/live keep
+        kind = (resource.get("kind") or "") if isinstance(resource, dict) else ""
+        kid = tensors.kind_index.get(kind, -1)
+        bmeta = int((row.bmeta & ~np.uint32(0xFFFF)) | np.uint32(kid + 1))
+        row = PackedRow(cells=row.cells, bmeta=bmeta,
+                        str_bytes=row.str_bytes, dictv=row.dictv)
+    return MemoRow(row=row, n_paths=n_new, epoch=tensors.dict_epoch), True
 
 
 def split_packed_rows(batch: PackedBatch) -> list[PackedRow]:

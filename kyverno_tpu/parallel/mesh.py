@@ -22,6 +22,7 @@ from ..models.engine import CompiledPolicySet
 from ..models.flatten import (
     BATCH_ARRAYS,
     FlatBatch,
+    pad_fill,
     pad_packed,
     unpack_batch,
 )
@@ -36,8 +37,10 @@ def make_mesh(devices=None, axis: str = "data") -> Mesh:
 def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
     """Pad the batch axis to a multiple of the mesh size. Padded rows carry
     no valid slots, so the kernel reports NOT_APPLICABLE for them. Derives
-    the field list from flatten.BATCH_ARRAYS so a FlatBatch schema change
-    cannot silently desynchronize the mesh path again."""
+    the field list from flatten.BATCH_ARRAYS and the per-field fill from
+    flatten.PAD_FILL — the single fill table every padding site shares —
+    so a FlatBatch schema or sentinel change cannot silently
+    desynchronize the mesh path again."""
     b = batch.n
     padded = (b + multiple - 1) // multiple * multiple
     if padded == b:
@@ -48,8 +51,7 @@ def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
     for name in BATCH_ARRAYS + ("num_val",):
         x = getattr(batch, name)
         width = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        fill = -1 if name == "kind_id" else 0
-        updates[name] = np.pad(x, width, constant_values=fill)
+        updates[name] = np.pad(x, width, constant_values=pad_fill(name))
     return replace(batch, **updates), b
 
 
@@ -104,13 +106,18 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     """
     fn = sharded_eval_fn(cps, mesh, axis)
 
+    n_live = cps.tensors.n_rules_live
+
     def eval_chunk(chunk: list[dict]):
         pb = cps.flatten_packed(chunk)
         cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, mesh.devices.size)
         verdict, fails, passes = fn(cells, bmeta, pb.str_bytes, pb.dictv)
         # materialize here: backpressure — the worker owns its chunk until
-        # the device is done with it
-        return np.array(verdict)[:n], np.array(fails), np.array(passes)
+        # the device is done with it. Slice the rule axis back to the
+        # live rules: an incremental tensor set pads it to a power-of-two
+        # bucket (inert rules score NOT_APPLICABLE)
+        return (np.array(verdict)[:n, :n_live], np.array(fails)[:n_live],
+                np.array(passes)[:n_live])
 
     if len(resources) <= chunk_size:
         verdicts, fails, passes = eval_chunk(resources)

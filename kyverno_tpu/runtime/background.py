@@ -6,12 +6,25 @@ per-resource loop on 2 workers, the whole snapshot is flattened once and
 scored as a policy x resource matrix on device (CompiledPolicySet), with
 the CPU oracle lane for host-only rules — the mesh-scale replay of
 BASELINE.md config [5]. Results feed the report pipeline.
+
+Delta scanning (KTPU_INCREMENTAL, default on): the scanner persists the
+verdict matrix between passes, keyed by (resource key) x (policy, rule).
+A policy change re-evaluates only the changed segments' rule *columns*
+against the memoized flatten rows (assembled as a sub-set over the same
+append-only dictionary, so the rows splice unchanged); a resource watch
+event re-evaluates only that dirty *row* against the full set. Everything
+else is spliced from the persisted matrix, and only the affected
+responses re-enter the report pipeline (ReportGenerator's freshest-wins
+store merges them). ``KTPU_INCREMENTAL=0`` restores the full-rescan path
+exactly.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+
+import numpy as np
 
 from ..engine.response import (
     EngineResponse,
@@ -40,6 +53,11 @@ class ScanResult:
     violations: int = 0
     duration_s: float = 0.0
     responses: list[EngineResponse] = field(default_factory=list)
+    # delta-pass accounting: what the incremental path actually evaluated
+    # (a full pass leaves these at the trivial values)
+    delta: bool = False
+    cols_evaluated: int = 0
+    rows_evaluated: int = 0
 
 
 class ResourceManager:
@@ -68,12 +86,52 @@ class BackgroundScanner:
 
     def __init__(self, policies: list, client=None,
                  report_gen: ReportGenerator | None = None, mesh=None):
-        self.policies = [p for p in policies if p.spec.background]
         self.client = client
         self.report_gen = report_gen
         self.mesh = mesh
         self.resource_manager = ResourceManager()
+        from ..models.compiler import incremental_enabled
+        self._inc = None
+        if incremental_enabled():
+            from ..models.engine import IncrementalCompiler
+
+            self._inc = IncrementalCompiler()
+        # persisted scan state between passes (delta scanning): row keys
+        # in scan order, resource bodies, flatten-row memos, and the
+        # verdict matrix as per-(policy, rule) columns — column keying
+        # survives rule-axis relayout across policy churn
+        self._state: dict | None = None
+        self._events: list[tuple[str, dict]] = []
+        self.delta_stats = {"full_scans": 0, "delta_scans": 0,
+                            "cols_evaluated": 0, "rows_evaluated": 0}
+        self._apply_policies(policies)
+
+    # -------------------------------------------------------- policy feed
+
+    def _apply_policies(self, policies: list) -> dict:
+        self.policies = [p for p in policies if p.spec.background]
+        if self._inc is not None:
+            self.cps = self._inc.refresh(self.policies)
+            return self._inc.last_refresh
         self.cps = CompiledPolicySet(self.policies)
+        return {}
+
+    def update_policies(self, policies: list) -> dict:
+        """Replace the scanned policy set. With incremental compilation
+        only segments whose policy object changed recompile; the refresh
+        summary (recompiled/dropped keys) seeds the next delta pass."""
+        return self._apply_policies(policies)
+
+    def note_resource(self, event: str, resource: dict) -> None:
+        """Resource watch feed: the row goes dirty for the next delta
+        pass (DELETED rows are dropped from the matrix)."""
+        self._events.append((event, resource))
+
+    @staticmethod
+    def _res_key(resource: dict) -> tuple:
+        meta = resource.get("metadata") or {}
+        return (resource.get("kind", ""), meta.get("namespace", ""),
+                meta.get("name", ""))
 
     def kinds(self) -> list[str]:
         out: list[str] = []
@@ -95,17 +153,30 @@ class BackgroundScanner:
             resources.extend(self.client.list_resource("", kind))
         return resources
 
+    # --------------------------------------------------------- full scan
+
     def scan(self, resources: list[dict] | None = None) -> ScanResult:
         start = time.monotonic()
         resources = resources if resources is not None else self.snapshot()
         result = ScanResult(resources_scanned=len(resources))
+        self.delta_stats["full_scans"] += 1
+        # a full pass supersedes any pending row dirt
+        self._events.clear()
         if not resources:
+            if self._inc is not None and self.mesh is None:
+                self._state = {"keys": [], "resources": {}, "memos": {},
+                               "cols": {}}
             return result
 
+        memos = None
         if self.mesh is not None:
             from ..parallel import sharded_scan
 
             verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
+        elif self._inc is not None:
+            # flatten chunk-wise and keep the split rows: the same single
+            # flatten both scores this pass and seeds the delta state
+            verdicts, memos = self._scan_rows(resources)
         else:
             from ..models.flatten import pipeline_enabled
             from ..parallel.mesh import DEFAULT_CHUNK
@@ -117,7 +188,7 @@ class BackgroundScanner:
                 # scores chunk k (KTPU_FLATTEN_PIPELINE=0 falls back to
                 # the serial chunk loop below)
                 verdicts = self.cps.evaluate_pipelined(resources,
-                                                       chunk=DEFAULT_CHUNK)
+                                                      chunk=DEFAULT_CHUNK)
             else:
                 # chunk huge snapshots so flatten memory stays bounded
                 import numpy as _np
@@ -127,38 +198,250 @@ class BackgroundScanner:
                     for i in range(0, len(resources), DEFAULT_CHUNK)])
 
         for b, resource in enumerate(resources):
-            meta = resource.get("metadata") or {}
-            per_policy: dict[str, EngineResponse] = {}
-            for ref in self.cps.rule_refs:
-                verdict = Verdict(verdicts[b, ref.rule_index])
-                if verdict is Verdict.NOT_APPLICABLE:
-                    continue
-                status = _VERDICT_TO_STATUS.get(verdict)
-                if status is None:
-                    continue
-                result.rules_evaluated += 1
-                if status is RuleStatus.FAIL:
-                    result.violations += 1
-                resp = per_policy.get(ref.policy.name)
-                if resp is None:
-                    resp = EngineResponse(policy_response=PolicyResponse(
-                        policy=PolicySpecSummary(name=ref.policy.name),
-                        resource=ResourceSpec(
-                            kind=resource.get("kind", ""),
-                            api_version=resource.get("apiVersion", ""),
-                            namespace=meta.get("namespace", ""),
-                            name=meta.get("name", ""),
-                        ),
-                    ))
-                    per_policy[ref.policy.name] = resp
-                resp.policy_response.rules.append(RuleResponse(
-                    name=ref.rule.name, type=RuleType.VALIDATION, status=status,
-                    message=f"validation rule '{ref.rule.name}' "
-                            f"{'passed' if status is RuleStatus.PASS else status.value}",
-                ))
+            per_policy = self._row_responses(
+                resource, lambda ref, b=b: verdicts[b, ref.rule_index],
+                self.cps.rule_refs, result)
             result.responses.extend(per_policy.values())
+
+        if memos is not None:
+            keys = [self._res_key(r) for r in resources]
+            self._state = {
+                "keys": keys,
+                "resources": dict(zip(keys, resources)),
+                "memos": memos,
+                "cols": {(ref.policy.name, ref.rule.name):
+                         np.asarray(verdicts)[:, ref.rule_index].astype(
+                             np.int8)
+                         for ref in self.cps.rule_refs},
+            }
 
         if self.report_gen is not None:
             self.report_gen.add(*result.responses)
         result.duration_s = time.monotonic() - start
         return result
+
+    def _scan_rows(self, resources: list[dict]):
+        """Chunked flatten + device eval that also returns the split
+        flatten rows as epoch-stamped memos (one flatten serves both)."""
+        from ..models.flatten import MemoRow, split_packed_rows
+        from ..parallel.mesh import DEFAULT_CHUNK
+
+        tensors = self.cps.tensors
+        chunks = []
+        memos: dict[tuple, object] = {}
+        for i in range(0, len(resources), DEFAULT_CHUNK):
+            chunk = resources[i:i + DEFAULT_CHUNK]
+            batch = self.cps.flatten_packed(chunk)
+            chunks.append(np.asarray(self.cps.evaluate_device(batch)))
+            for r, row in zip(chunk, split_packed_rows(batch)):
+                memos[self._res_key(r)] = MemoRow(
+                    row=row, n_paths=tensors.n_paths,
+                    epoch=tensors.dict_epoch)
+        return np.concatenate(chunks), memos
+
+    def _row_responses(self, resource: dict, verdict_of, rule_refs,
+                       result: ScanResult,
+                       policy_filter: set | None = None) -> dict:
+        """One resource's per-policy EngineResponses (the response shape
+        both the full and the delta pass emit, so report rows merge)."""
+        meta = resource.get("metadata") or {}
+        per_policy: dict[str, EngineResponse] = {}
+        for ref in rule_refs:
+            if policy_filter is not None and \
+                    ref.policy.name not in policy_filter:
+                continue
+            verdict = Verdict(verdict_of(ref))
+            if verdict is Verdict.NOT_APPLICABLE:
+                continue
+            status = _VERDICT_TO_STATUS.get(verdict)
+            if status is None:
+                continue
+            result.rules_evaluated += 1
+            if status is RuleStatus.FAIL:
+                result.violations += 1
+            resp = per_policy.get(ref.policy.name)
+            if resp is None:
+                resp = EngineResponse(policy_response=PolicyResponse(
+                    policy=PolicySpecSummary(name=ref.policy.name),
+                    resource=ResourceSpec(
+                        kind=resource.get("kind", ""),
+                        api_version=resource.get("apiVersion", ""),
+                        namespace=meta.get("namespace", ""),
+                        name=meta.get("name", ""),
+                    ),
+                ))
+                per_policy[ref.policy.name] = resp
+            resp.policy_response.rules.append(RuleResponse(
+                name=ref.rule.name, type=RuleType.VALIDATION, status=status,
+                message=f"validation rule '{ref.rule.name}' "
+                        f"{'passed' if status is RuleStatus.PASS else status.value}",
+            ))
+        return per_policy
+
+    # -------------------------------------------------------- delta scan
+
+    def delta_scan(self, policies: list | None = None) -> ScanResult:
+        """Incremental pass: apply any policy update, then re-evaluate
+        only (a) the changed/added policies' rule columns against the
+        memoized flatten rows and (b) the rows dirtied by resource watch
+        events against the full set, splicing both into the persisted
+        verdict matrix. Emits responses only for the affected
+        (resource, policy) pairs. Falls back to :meth:`scan` when
+        incremental compilation is off, under a mesh, or before any full
+        pass has seeded the state."""
+        refresh = self.update_policies(policies) if policies is not None \
+            else {}
+        if self._inc is None or self._state is None or \
+                self.mesh is not None:
+            return self.scan()
+        start = time.monotonic()
+        state = self._state
+        result = ScanResult(delta=True)
+        self.delta_stats["delta_scans"] += 1
+
+        current_names = {p.name for p in self.policies}
+        new_cols = {(ref.policy.name, ref.rule.name)
+                    for ref in self.cps.rule_refs}
+
+        # ---- policy-side dirt: recompiled segments + columns the matrix
+        # has never seen (fresh policies, first delta after fallback)
+        changed_keys = set(refresh.get("recompiled_keys", []))
+        changed_policies = []
+        for p in self.policies:
+            key = self._inc._policy_key(p)
+            missing = any(ck not in state["cols"] for ck in new_cols
+                          if ck[0] == p.name)
+            if key in changed_keys or missing:
+                changed_policies.append(p)
+        changed_names = {p.name for p in changed_policies}
+
+        # ---- resource-side dirt: consume watch events
+        events, self._events = self._events, []
+        dirty: list[tuple] = []
+        for event, resource in events:
+            key = self._res_key(resource)
+            if event == "DELETED":
+                if key in state["resources"]:
+                    idx = state["keys"].index(key)
+                    state["keys"].pop(idx)
+                    state["resources"].pop(key, None)
+                    state["memos"].pop(key, None)
+                    for ck in state["cols"]:
+                        state["cols"][ck] = np.delete(state["cols"][ck],
+                                                      idx)
+                    if self.report_gen is not None:
+                        self.report_gen.prune_resource(key[0], key[1],
+                                                       key[2])
+                if key in dirty:
+                    dirty.remove(key)
+                continue
+            if key not in state["resources"]:
+                state["keys"].append(key)
+                for ck in state["cols"]:
+                    state["cols"][ck] = np.append(
+                        state["cols"][ck],
+                        np.int8(Verdict.NOT_APPLICABLE))
+            state["resources"][key] = resource
+            # content changed: the memo row is for the old body
+            state["memos"].pop(key, None)
+            if key not in dirty:
+                dirty.append(key)
+
+        # ---- column pass: changed policies x all memoized rows, over a
+        # sub-set assembled from the same dictionary (rows splice as-is)
+        if changed_policies and state["keys"]:
+            from ..models.flatten import (MemoRow, flatten_one_row,
+                                          refresh_packed_row,
+                                          splice_packed_rows)
+
+            sub = self._inc.subset(changed_policies)
+            rows = []
+            for key in state["keys"]:
+                resource = state["resources"][key]
+                memo = state["memos"].get(key)
+                refreshed = None
+                if memo is not None:
+                    refreshed, _ = refresh_packed_row(memo, resource,
+                                                      sub.tensors)
+                if refreshed is None:
+                    refreshed = MemoRow(
+                        row=flatten_one_row(resource, sub.tensors),
+                        n_paths=sub.tensors.n_paths,
+                        epoch=sub.tensors.dict_epoch)
+                state["memos"][key] = refreshed
+                rows.append(refreshed.row)
+            v = np.asarray(sub.evaluate_device(splice_packed_rows(rows)))
+            for ref in sub.rule_refs:
+                state["cols"][(ref.policy.name, ref.rule.name)] = \
+                    v[:, ref.rule_index].astype(np.int8)
+                result.cols_evaluated += 1
+
+        # ---- drop columns of removed policies / removed rules
+        for ck in list(state["cols"]):
+            if ck in new_cols:
+                continue
+            if ck[0] not in current_names or ck[0] in changed_names:
+                del state["cols"][ck]
+        for key in refresh.get("dropped_keys", []):
+            if self.report_gen is not None:
+                self.report_gen.prune_policy(key.split("/")[-1])
+
+        # ---- row pass: dirty resources x the full set
+        dirty = [k for k in dirty if k in state["resources"]]
+        if dirty:
+            from ..models.flatten import MemoRow, split_packed_rows
+
+            tensors = self.cps.tensors
+            bodies = [state["resources"][k] for k in dirty]
+            batch = self.cps.flatten_packed(bodies)
+            v = np.asarray(self.cps.evaluate_device(batch))
+            split = split_packed_rows(batch)
+            for j, key in enumerate(dirty):
+                idx = state["keys"].index(key)
+                for ref in self.cps.rule_refs:
+                    state["cols"][(ref.policy.name, ref.rule.name)][idx] = \
+                        np.int8(v[j, ref.rule_index])
+                state["memos"][key] = MemoRow(
+                    row=split[j], n_paths=tensors.n_paths,
+                    epoch=tensors.dict_epoch)
+                result.rows_evaluated += 1
+
+        # ---- emit only the affected (resource, policy) responses; the
+        # report store's freshest-wins merge keeps everything else
+        dirty_set = set(dirty)
+        refs = self.cps.rule_refs
+        for key in state["keys"]:
+            names = (current_names if key in dirty_set
+                     else changed_names)
+            if not names:
+                continue
+            idx = state["keys"].index(key)
+            per_policy = self._row_responses(
+                state["resources"][key],
+                lambda ref, idx=idx: state["cols"][
+                    (ref.policy.name, ref.rule.name)][idx],
+                refs, result, policy_filter=names)
+            result.responses.extend(per_policy.values())
+
+        result.resources_scanned = len(state["keys"])
+        self.delta_stats["cols_evaluated"] += result.cols_evaluated
+        self.delta_stats["rows_evaluated"] += result.rows_evaluated
+        if self.report_gen is not None:
+            self.report_gen.add(*result.responses)
+        result.duration_s = time.monotonic() - start
+        return result
+
+    def verdict_matrix(self):
+        """(row keys, column keys, matrix) snapshot of the persisted scan
+        state — the parity surface the delta-vs-full property tests
+        compare bit-for-bit. None before any full pass."""
+        if self._state is None:
+            return None
+        state = self._state
+        ckeys = sorted(state["cols"])
+        n = len(state["keys"])
+        if ckeys:
+            mat = np.stack([state["cols"][c] for c in ckeys], axis=1)
+        else:
+            mat = np.zeros((n, 0), dtype=np.int8)
+        return list(state["keys"]), ckeys, mat

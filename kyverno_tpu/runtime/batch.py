@@ -173,16 +173,27 @@ class AdmissionBatcher:
         self.result_cache_ttl_s = result_cache_ttl_s
         self.result_cache_max = result_cache_max
         self._result_cache: dict = {}
-        # flatten-row memo (tentpole piece 1): per-resource flattened rows
-        # keyed by (tensors fingerprint, resource digest). Orthogonal to
-        # the decision cache above: a burst of DISTINCT resources misses
+        # flatten-row memo: per-resource flattened rows keyed by
+        # (tensors memo space, resource digest). Orthogonal to the
+        # decision cache above: a burst of DISTINCT resources misses
         # every decision key, but repeat resource *shapes* (the same Pod
         # re-admitted, a warmup resource, a retried request) still skip
-        # the flatten. Fingerprint keying makes recompile invalidation
-        # structural — a new path dictionary is a new key space.
+        # the flatten. The memo space is the dictionary lineage
+        # (dict_base) for incremental tensor sets — rows carry their
+        # epoch and survive policy updates via delta refresh — and the
+        # structural fingerprint otherwise, where a recompile that moves
+        # the dictionary is a new key space.
         from .resourcecache import FlattenRowCache
 
         self._row_cache = FlattenRowCache(max_rows=row_cache_max)
+        # warmup seeds by population, replayed on policy change so the
+        # post-update first burst finds warm XLA buckets and a primed
+        # memo (re-warm runs on its own thread: warmup blocks on the
+        # flush pool, so running it ON the pool could deadlock it)
+        self._warm_seeds: dict[tuple, tuple] = {}
+        self._rewarm_pending = False
+        if hasattr(policy_cache, "add_listener"):
+            policy_cache.add_listener(self._on_policy_change)
         # per-CompiledPolicySet shape buckets already compiled; weak keys
         # so dead policy generations vanish (an id()-keyed set could both
         # leak and misclassify a fresh compile after id reuse)
@@ -305,6 +316,9 @@ class AdmissionBatcher:
         tensor at controller start'), so the first real burst never pays
         XLA compilation inline. With the admission pad floor, every size
         in ``batch_sizes`` up to PAD_FLOOR lands on one compiled shape."""
+        with self._lock:
+            self._warm_seeds[(int(ptype), kind, namespace)] = (
+                ptype, kind, namespace, resource, batch_sizes)
         try:
             cps = self.policy_cache.compiled(ptype, kind, namespace)
         except Exception:
@@ -328,12 +342,7 @@ class AdmissionBatcher:
         batch, _ = self._pad_admission(raw)
         shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
         handle = cps.evaluate_device_async(batch)   # compile
-        if deferred is not None:
-            from ..models.flatten import split_packed_rows
-
-            fp, digests, fresh = deferred
-            for d, row in zip(digests, split_packed_rows(fresh)):
-                self._row_cache.put(fp, d, row)
+        self._store_deferred(deferred)
         handle.get()
         t0 = time.monotonic()
         cps.evaluate_device_async(batch).get()      # measure steady state
@@ -342,6 +351,33 @@ class AdmissionBatcher:
             self._seen_shapes.setdefault(cps, set()).add(shape_key)
             self._dispatch_cost += 0.3 * (dt - self._dispatch_cost)
             self._last_dispatch = time.monotonic()
+
+    def _on_policy_change(self, event: str, policy) -> None:
+        """Policy-cache listener: replay the recorded warmup seeds so the
+        freshly-spliced tensor set gets its XLA buckets compiled and its
+        memo rows refreshed BEFORE the next admission burst arrives.
+        Coalesced — a storm of updates triggers one re-warm pass at a
+        time — and run on a dedicated thread (never the flush pool:
+        warmup waits on flush-pool futures)."""
+        with self._lock:
+            if self._stopped or not self._warm_seeds or self._rewarm_pending:
+                return
+            self._rewarm_pending = True
+        threading.Thread(target=self._rewarm, name="adm-rewarm",
+                         daemon=True).start()
+
+    def _rewarm(self) -> None:
+        try:
+            with self._lock:
+                seeds = list(self._warm_seeds.values())
+                self.stats["rewarm"] = self.stats.get("rewarm", 0) + 1
+            for ptype, kind, ns, resource, sizes in seeds:
+                with contextlib.suppress(Exception):
+                    self.warmup(ptype, kind, ns, resource,
+                                batch_sizes=sizes)
+        finally:
+            with self._lock:
+                self._rewarm_pending = False
 
     # ------------------------------------------------------------- cache
 
@@ -629,29 +665,50 @@ class AdmissionBatcher:
 
         if not pipeline_enabled():
             return cps.flatten_packed(resources), 0, 0, None
-        fp = cps.tensors.fingerprint
+        tensors = cps.tensors
+        space = tensors.memo_space
         cache = self._row_cache
         digests = [cache.digest(r) for r in resources]
-        rows = [cache.get(fp, d) for d in digests]
+        # epoch-aware lookup: a memo row cut at an older dict epoch of
+        # the same lineage is delta-refreshed (only the appended paths
+        # flatten) and still counts as a hit — the survival that keeps a
+        # policy-update storm from flushing the memo
+        rows = [cache.get_row(space, d, r, tensors)
+                for d, r in zip(digests, resources)]
         n_hits = sum(r is not None for r in rows)
         if n_hits == 0:
             batch = cps.flatten_packed(resources)
-            return batch, 0, len(resources), (fp, digests, batch)
+            return batch, 0, len(resources), (space, digests, batch,
+                                              tensors)
         miss_idx = [i for i, r in enumerate(rows) if r is None]
         if miss_idx:
             miss_rows = split_packed_rows(
                 cps.flatten_packed([resources[i] for i in miss_idx]))
             for j, i in enumerate(miss_idx):
                 rows[i] = miss_rows[j]
-                cache.put(fp, digests[i], miss_rows[j])
+                cache.put_row(space, digests[i], miss_rows[j],
+                              tensors.n_paths, tensors.dict_epoch)
         return splice_packed_rows(rows), n_hits, len(miss_idx), None
+
+    def _store_deferred(self, deferred) -> None:
+        """Split a zero-hit flush's fresh batch into memo rows and store
+        them with their dictionary coordinates (runs inside the async
+        dispatch's shadow on the hot path)."""
+        if deferred is None:
+            return
+        from ..models.flatten import split_packed_rows
+
+        space, digests, fresh, tensors = deferred
+        for d, row in zip(digests, split_packed_rows(fresh)):
+            self._row_cache.put_row(space, d, row, tensors.n_paths,
+                                    tensors.dict_epoch)
 
     def _flush(self, cps, items, is_probe: bool = False) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
         try:
-            from ..models.flatten import pipeline_enabled, split_packed_rows
+            from ..models.flatten import pipeline_enabled
 
             for *_, fut in items:
                 # waiters whose adaptive deadline expires while this
@@ -690,19 +747,14 @@ class AdmissionBatcher:
                 handle = cps.evaluate_device_async(batch)
                 t_disp = time.monotonic()
                 if deferred is not None:
-                    fp, digests, fresh = deferred
-                    for d, row in zip(digests, split_packed_rows(fresh)):
-                        self._row_cache.put(fp, d, row)
+                    self._store_deferred(deferred)
                     overlap_s = time.monotonic() - t_disp
                 verdicts = handle.get()
             else:
                 # cold flush: the "dispatch" is an XLA compile holding the
                 # host anyway — overlap buys nothing, keep it simple
                 verdicts = np.asarray(cps.evaluate_device(batch))
-                if deferred is not None:
-                    fp, digests, fresh = deferred
-                    for d, row in zip(digests, split_packed_rows(fresh)):
-                        self._row_cache.put(fp, d, row)
+                self._store_deferred(deferred)
             dt = time.monotonic() - t0
             cpu_dt = time.thread_time() - cpu0
             with self._lock:
@@ -872,6 +924,14 @@ class AdmissionBatcher:
             if overlap_s > 0:
                 self.stats["overlap_s_saved"] = (
                     self.stats.get("overlap_s_saved", 0.0) + overlap_s)
+        # cumulative memo survival (exact hits + epoch-extended rows over
+        # all lookups) — the number that must stay high through a
+        # policy-update storm
+        memo = self._row_cache.stats()
+        with self._lock:
+            self.stats["flatten_memo_survival_ratio"] = (
+                memo["survival_ratio"])
+            self.stats["flatten_memo_extended_rows"] = memo["extended"]
         try:
             from . import metrics as metrics_mod
 
@@ -884,6 +944,9 @@ class AdmissionBatcher:
             if overlap_s > 0:
                 metrics_mod.record_pipeline_overlap(reg, overlap_s)
             metrics_mod.record_flush_queue_depth(reg, queue_depth)
+            if memo["hits"] or memo["misses"]:
+                metrics_mod.record_memo_survival(reg,
+                                                 memo["survival_ratio"])
         except Exception:
             pass
 

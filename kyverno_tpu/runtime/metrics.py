@@ -215,6 +215,43 @@ def record_flush_queue_depth(registry: MetricsRegistry, depth: int) -> None:
                        float(depth))
 
 
+def record_policy_compile(registry: MetricsRegistry, seconds: float,
+                          mode: str) -> None:
+    """Tensor-set compile time per population rebuild, labelled
+    ``mode="full"`` (from-scratch CompiledPolicySet) or
+    ``mode="incremental"`` (segment splice — only the touched policy's
+    segment recompiled). The incremental/full ratio under a policy-update
+    storm is the headline number of bench config 6."""
+    registry.observe("kyverno_policy_compile_seconds", {"mode": mode},
+                     seconds)
+
+
+def record_segments_spliced(registry: MetricsRegistry, count: int) -> None:
+    """Segments reused verbatim (spliced, not recompiled) across
+    incremental tensor-set refreshes. For an N-policy population, a
+    single-policy update should splice N-1."""
+    if count:
+        registry.inc_counter("kyverno_policy_segments_spliced_total", {},
+                             float(count))
+
+
+def record_memo_survival(registry: MetricsRegistry, ratio: float) -> None:
+    """Fraction of flatten-row memo lookups served without a full
+    re-flatten (exact hits + epoch-extended rows) since startup. Falling
+    toward 0 after policy churn means memos are being evicted instead of
+    revalidated — the storm regression this PR's epoch keying prevents."""
+    registry.set_gauge("kyverno_flatten_memo_survival_ratio", {}, ratio)
+
+
+def record_dict_epoch(registry: MetricsRegistry, population: str,
+                      epoch: int) -> None:
+    """Append counter of a population's tensor dictionary. Monotonically
+    increasing by small steps is healthy churn; a reset to a small value
+    means the lineage was rebuilt and every memo keyed on it died."""
+    registry.set_gauge("kyverno_policy_dict_epoch",
+                       {"population": population}, float(epoch))
+
+
 def record_screen_escalation(registry: MetricsRegistry, reason: str,
                              value: float = 1.0) -> None:
     """Why a screened admission row escalated past CLEAN — the routing

@@ -60,6 +60,15 @@ class PolicyCache:
         self._listeners: list = []
         # policy key -> AnalysisReport from the warn-only admission lint
         self.lint_reports: dict[str, object] = {}
+        # (ptype, kind, namespace) -> IncrementalCompiler: per-population
+        # segment caches + append-only dictionaries (KTPU_INCREMENTAL=1)
+        self._incremental: dict[tuple, object] = {}
+        # last compile + cumulative compile accounting (bench/stats seam)
+        self.compile_stats: dict = {}
+        self.compile_totals = {"full_n": 0, "full_s": 0.0,
+                               "incremental_n": 0, "incremental_s": 0.0,
+                               "segments_spliced": 0,
+                               "segments_recompiled": 0}
 
     def add_listener(self, fn) -> None:
         """fn(event, policy) fires after add/update ("SET") and remove
@@ -213,17 +222,72 @@ class PolicyCache:
 
     def compiled(self, ptype: PolicyType, kind: str, namespace: str = ""):
         """The precompiled tensor set for an admission population; cached
-        until the policy set changes."""
+        until the policy set changes. With KTPU_INCREMENTAL on (default)
+        a change recompiles only the touched policy's segment and splices
+        it into the population's existing tensors (per-population
+        IncrementalCompiler); KTPU_INCREMENTAL=0 restores the historical
+        full recompile."""
+        import time as _time
+
         from ..models import CompiledPolicySet
+        from ..models.compiler import incremental_enabled
 
         with self._lock:
             cache_key = (int(ptype), _title(kind), namespace, self._generation)
             cps = self._compiled.get(cache_key)
             if cps is None:
                 policies = self.get_policies(ptype, kind, namespace)
-                cps = CompiledPolicySet(policies)
+                t0 = _time.perf_counter()
+                if incremental_enabled():
+                    from ..models.engine import IncrementalCompiler
+
+                    pop = cache_key[:3]
+                    inc = self._incremental.get(pop)
+                    if inc is None:
+                        inc = self._incremental[pop] = IncrementalCompiler()
+                    cps = inc.refresh(policies)
+                    self._note_compile("incremental",
+                                       _time.perf_counter() - t0, pop, cps,
+                                       inc.last_refresh)
+                else:
+                    cps = CompiledPolicySet(policies)
+                    self._note_compile("full", _time.perf_counter() - t0,
+                                       cache_key[:3], cps, None)
                 self._compiled = {cache_key: cps, **{
                     k: v for k, v in self._compiled.items()
                     if k[3] == self._generation
                 }}
             return cps
+
+    def _note_compile(self, mode: str, seconds: float, pop: tuple,
+                      cps, refresh: dict | None) -> None:
+        """Compile accounting: cache-local stats for bench/tests plus the
+        churn metrics (never raises — observability must not take down
+        admission)."""
+        refresh = refresh or {}
+        reused = int(refresh.get("reused", 0))
+        recompiled = int(refresh.get("recompiled", 0))
+        self.compile_stats = {
+            "mode": mode, "seconds": seconds,
+            "population": pop,
+            "n_policies": len(cps.policies),
+            "segments_reused": reused,
+            "segments_recompiled": recompiled,
+            "dict_epoch": cps.tensors.dict_epoch,
+        }
+        self.compile_totals[f"{mode}_n"] += 1
+        self.compile_totals[f"{mode}_s"] += seconds
+        self.compile_totals["segments_spliced"] += reused
+        self.compile_totals["segments_recompiled"] += recompiled
+        try:
+            from .metrics import (record_dict_epoch, record_policy_compile,
+                                  record_segments_spliced, registry)
+
+            reg = registry()
+            record_policy_compile(reg, seconds, mode)
+            if mode == "incremental":
+                record_segments_spliced(reg, reused)
+                record_dict_epoch(reg, "/".join(str(p) for p in pop),
+                                  cps.tensors.dict_epoch)
+        except Exception:
+            logger.exception("compile metrics recording failed")

@@ -208,6 +208,19 @@ class ReportGenerator:
             existing["results"] = rcr["results"]
             self.client.update_resource(existing)
 
+    @staticmethod
+    def _filter_pending(pending: list[dict], keep) -> list[dict]:
+        """Apply a per-result predicate to not-yet-consumed change
+        requests: results produced before a prune are just as stale as
+        already-consumed ones, and must not resurrect at the next
+        aggregate()."""
+        out = []
+        for rcr in pending:
+            results = [r for r in rcr.get("results") or [] if keep(rcr, r)]
+            if results:
+                out.append({**rcr, "results": results})
+        return out
+
     def prune_policy(self, policy_name: str) -> None:
         """Drop all results of a deleted policy (policy delete handler in
         reportcontroller.go's full reconcile)."""
@@ -215,6 +228,9 @@ class ReportGenerator:
             self._results = {
                 k: v for k, v in self._results.items() if k[1] != policy_name
             }
+            self._pending = self._filter_pending(
+                self._pending,
+                lambda rcr, r: r.get("policy") != policy_name)
 
     def prune_resource(self, kind: str, namespace: str, name: str) -> None:
         """Drop all results for a deleted resource."""
@@ -223,6 +239,14 @@ class ReportGenerator:
                 k: v for k, v in self._results.items()
                 if not (k[0] == namespace and k[3] == kind and k[4] == name)
             }
+
+            def keep(rcr, r):
+                ns = (rcr.get("metadata") or {}).get("namespace", "")
+                res = (r.get("resources") or [{}])[0]
+                return not (ns == namespace and res.get("kind") == kind
+                            and res.get("name") == name)
+
+            self._pending = self._filter_pending(self._pending, keep)
 
     def reconcile(self) -> None:
         """Full rebuild: forget the current state so the next scan/audit

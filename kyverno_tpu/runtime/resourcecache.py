@@ -205,7 +205,14 @@ class FlattenRowCache:
     (resource, request-envelope) pair — flattening never depends on dict
     key order, so the canonicalization is sound, and resources that JSON
     can't serialize simply skip the memo (the native flattener routes
-    those to the host lane anyway). LRU-bounded by row count."""
+    those to the host lane anyway). LRU-bounded by row count.
+
+    With incremental compilation the key space is the dictionary lineage
+    (PolicyTensors.memo_space = dict_base) rather than the fingerprint,
+    and entries are MemoRow (models/flatten.py) carrying their epoch:
+    ``get_row``/``put_row`` revalidate rows across policy updates by
+    delta-flattening only the appended paths, so a policy-update storm
+    keeps the memo warm instead of flushing it."""
 
     def __init__(self, max_rows: int = 4096):
         from collections import OrderedDict
@@ -215,6 +222,7 @@ class FlattenRowCache:
         self._rows: "OrderedDict[tuple[str, bytes], object]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.extended = 0         # epoch-refreshed survivals within hits
 
     @staticmethod
     def digest(resource: dict, request: dict | None = None) -> bytes | None:
@@ -252,14 +260,67 @@ class FlattenRowCache:
             while len(self._rows) > self.max_rows:
                 self._rows.popitem(last=False)
 
+    def get_row(self, space: str, digest: bytes | None, resource: dict,
+                tensors, request: dict | None = None):
+        """Epoch-aware lookup for incremental tensor sets: returns the
+        memoized PackedRow revalidated against ``tensors`` (models/flatten
+        refresh_packed_row), or None on miss / foreign lineage. An
+        epoch-extended row counts as a hit — the prefix flatten work
+        survived the policy update."""
+        from ..models.flatten import MemoRow, refresh_packed_row
+
+        if digest is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        key = (space, digest)
+        with self._lock:
+            memo = self._rows.get(key)
+            if not isinstance(memo, MemoRow):
+                self.misses += 1
+                return None
+            self._rows.move_to_end(key)
+        refreshed, ext = refresh_packed_row(memo, resource, tensors,
+                                            request=request)
+        with self._lock:
+            if refreshed is None:
+                self.misses += 1
+                self._rows.pop(key, None)
+                return None
+            self.hits += 1
+            if ext:
+                self.extended += 1
+                # a concurrent put may have stored a fresher entry; only
+                # upgrade our own stale one
+                if self._rows.get(key) is memo:
+                    self._rows[key] = refreshed
+        return refreshed.row
+
+    def put_row(self, space: str, digest: bytes | None, row,
+                n_paths: int, epoch: int) -> None:
+        """Store a freshly-split PackedRow with its dictionary coordinates
+        so later epochs can revalidate instead of re-flattening."""
+        from ..models.flatten import MemoRow
+
+        self.put(space, digest, MemoRow(row=row, n_paths=n_paths,
+                                        epoch=epoch))
+
+    def survival_ratio(self) -> float:
+        with self._lock:
+            total = self.hits + self.misses
+            return self.hits / total if total else 0.0
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._rows)
 
     def stats(self) -> dict:
         with self._lock:
+            total = self.hits + self.misses
             return {"rows": len(self._rows), "hits": self.hits,
-                    "misses": self.misses}
+                    "misses": self.misses, "extended": self.extended,
+                    "survival_ratio": (self.hits / total if total
+                                       else 0.0)}
 
     def clear(self) -> None:
         with self._lock:

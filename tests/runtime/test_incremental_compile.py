@@ -1,0 +1,317 @@
+"""Incremental compilation acceptance suite (ISSUE 4 tentpole).
+
+The property at the center: under ANY churn sequence — add / update /
+remove over a 20+ policy library — the segmented splice path must
+produce bit-identical verdict matrices to a from-scratch compile, the
+epoch-refreshed flatten memos must splice indistinguishably from fresh
+flattens, and ``KTPU_INCREMENTAL=0`` must restore the monolithic
+compile exactly. Plus the KT304 regression: a corrupted splice
+(mangled segment offsets) is caught by the analyzer, not served.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+
+PATTERN_POOL = [
+    {"spec": {"containers": [{"image": "!*:latest"}]}},
+    {"spec": {"containers": [{"image": "!*:dev"}]}},
+    {"spec": {"weight": "<=100"}},
+    {"spec": {"weight": ">10"}},
+    {"spec": {"grace": "<1h"}},
+    {"metadata": {"name": "pod-?*"}},
+    {"metadata": {"labels": {"idx": "?*"}}},
+    {"spec": {"containers": [{"name": "c?*"}]}},
+]
+
+
+def _policy(name, pattern, background=False):
+    spec = {"validationFailureAction": "enforce", "rules": [{
+        "name": "r",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "m", "pattern": pattern},
+    }]}
+    if background:
+        spec["background"] = True
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name}, "spec": spec,
+    })
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": f"c{i}",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "grace": f"{(i * 13) % 400}s"}}
+
+
+def _library(rng, n=22):
+    return {f"pol-{i:02d}": _policy(f"pol-{i:02d}", rng.choice(PATTERN_POOL))
+            for i in range(n)}
+
+
+class TestRandomizedChurnParity:
+    @pytest.mark.slow
+    def test_incremental_matches_from_scratch_under_churn(self):
+        """20+ policies, 40 random add/update/remove steps: after every
+        step the incremental assembly's verdict matrix is bit-identical
+        to a from-scratch CompiledPolicySet over the same policies, and
+        memo rows carried across every epoch splice to the same verdicts
+        as fresh flattens."""
+        self._churn(steps=40, seed=0xC0FFEE)
+
+    def test_incremental_matches_from_scratch_short(self):
+        """Quick-gate slice of the same property (tier-1 runs with
+        ``-m 'not slow'``): fewer steps, different seed."""
+        self._churn(steps=6, seed=41)
+
+    def _churn(self, steps: int, seed: int):
+        from kyverno_tpu.models import CompiledPolicySet
+        from kyverno_tpu.models.engine import IncrementalCompiler
+        from kyverno_tpu.models.flatten import (
+            MemoRow,
+            refresh_packed_row,
+            splice_packed_rows,
+            split_packed_rows,
+        )
+
+        rng = random.Random(seed)
+        lib = _library(rng)
+        docs = [_pod(i) for i in range(8)]
+        inc = IncrementalCompiler()
+
+        cps = inc.refresh(list(lib.values()))
+        memos = [MemoRow(row=r, n_paths=cps.tensors.n_paths,
+                         epoch=cps.tensors.dict_epoch)
+                 for r in split_packed_rows(cps.flatten_packed(docs))]
+
+        next_id = len(lib)
+        for step in range(steps):
+            op = rng.choice(["add", "update", "remove"])
+            if op == "add":
+                name = f"pol-{next_id:02d}"
+                next_id += 1
+                lib[name] = _policy(name, rng.choice(PATTERN_POOL))
+            elif op == "update" and lib:
+                name = rng.choice(sorted(lib))
+                lib[name] = _policy(name, rng.choice(PATTERN_POOL))
+            elif lib and len(lib) > 3:
+                del lib[rng.choice(sorted(lib))]
+
+            policies = list(lib.values())
+            cps = inc.refresh(policies)
+            want = np.asarray(
+                CompiledPolicySet(policies).evaluate_device(
+                    CompiledPolicySet(policies).flatten_packed(docs)))
+            got = np.asarray(
+                cps.evaluate_device(cps.flatten_packed(docs)))
+            assert got.shape == want.shape, f"step {step} ({op})"
+            assert np.array_equal(got, want), f"step {step} ({op})"
+
+            # memo rows from epoch 0 refresh forward and splice to the
+            # exact same verdicts — the storm-survival property
+            refreshed = []
+            for m, d in zip(memos, docs):
+                m2, _ext = refresh_packed_row(m, d, cps.tensors)
+                assert m2 is not None, f"step {step}: memo lost lineage"
+                refreshed.append(m2)
+            memos = refreshed
+            spliced = np.asarray(cps.evaluate_device(
+                splice_packed_rows([m.row for m in memos])))
+            assert np.array_equal(spliced, want), f"step {step} splice"
+
+    def test_kill_switch_restores_monolithic_path(self, monkeypatch):
+        """KTPU_INCREMENTAL=0 must put PolicyCache back on the exact
+        historical compile: monolithic tensors (no segments, no rule
+        bucketing, no persistent dictionary lineage) with identical
+        verdicts."""
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        rng = random.Random(7)
+        policies = [_policy(f"p{i}", rng.choice(PATTERN_POOL))
+                    for i in range(6)]
+        docs = [_pod(i) for i in range(6)]
+
+        monkeypatch.setenv("KTPU_INCREMENTAL", "0")
+        cache = PolicyCache()
+        for p in policies:
+            cache.add(p)
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        t = cps.tensors
+        # legacy markers: no persistent dictionary lineage, no pow2
+        # rule-bucket padding (6 rules would bucket to 8)
+        assert t.dict_base is None
+        assert t.n_rules_live == t.n_rules == 6
+
+        from kyverno_tpu.models import CompiledPolicySet
+
+        want_cps = CompiledPolicySet(cps.policies)
+        assert t.fingerprint == want_cps.tensors.fingerprint
+        got = np.asarray(cps.evaluate_device(cps.flatten_packed(docs)))
+        want = np.asarray(
+            want_cps.evaluate_device(want_cps.flatten_packed(docs)))
+        assert np.array_equal(got, want)
+
+        # flipping the switch on routes the same population through the
+        # segmented path with the same verdicts
+        monkeypatch.setenv("KTPU_INCREMENTAL", "1")
+        cache2 = PolicyCache()
+        for p in policies:
+            cache2.add(p)
+        cps2 = cache2.compiled(PolicyType.VALIDATE_ENFORCE, "Pod",
+                               "default")
+        assert cps2.tensors.dict_base is not None
+        assert len(cps2.tensors.segments) == 6
+        assert cps2.tensors.n_rules == 8          # pow2 bucket
+        assert cps2.tensors.n_rules_live == 6
+        got2 = np.asarray(cps2.evaluate_device(cps2.flatten_packed(docs)))
+        assert np.array_equal(got2, want)
+
+
+class TestDeltaScanParity:
+    def test_delta_scan_matches_full_rescan(self):
+        """Policy churn then resource churn: delta_scan's persisted
+        verdict matrix stays bit-identical to a from-scratch scanner's,
+        while evaluating only the changed columns / dirty rows."""
+        from kyverno_tpu.runtime.background import BackgroundScanner
+
+        mk = lambda name, pat: _policy(name, pat, background=True)  # noqa: E731
+        p1 = [mk("a", PATTERN_POOL[0]), mk("b", PATTERN_POOL[2]),
+              mk("c", PATTERN_POOL[4])]
+        docs = [_pod(i) for i in range(10)]
+
+        sc = BackgroundScanner(p1)
+        sc.scan(docs)
+
+        p2 = [p1[0], mk("b", {"spec": {"weight": "<=50",
+                                       "newdeep": {"x": "?*"}}}),
+              mk("d", PATTERN_POOL[5])]
+        r1 = sc.delta_scan(p2)
+        assert r1.delta and r1.cols_evaluated == 2 and r1.rows_evaluated == 0
+
+        ref = BackgroundScanner(p2)
+        ref.scan(docs)
+        k_a, c_a, m_a = sc.verdict_matrix()
+        k_b, c_b, m_b = ref.verdict_matrix()
+        assert k_a == k_b and c_a == c_b
+        assert np.array_equal(m_a, m_b)
+
+        mod = _pod(1)
+        mod["spec"]["weight"] = 155
+        sc.note_resource("MODIFIED", mod)
+        sc.note_resource("DELETED", _pod(2))
+        sc.note_resource("ADDED", _pod(99))
+        r2 = sc.delta_scan()
+        assert r2.cols_evaluated == 0 and r2.rows_evaluated == 2
+
+        docs2 = [mod if d["metadata"]["name"] == "pod-1" else d
+                 for d in docs if d["metadata"]["name"] != "pod-2"]
+        docs2.append(_pod(99))
+        ref2 = BackgroundScanner(p2)
+        ref2.scan(docs2)
+        k_a, c_a, m_a = sc.verdict_matrix()
+        k_b, c_b, m_b = ref2.verdict_matrix()
+        assert c_a == c_b and set(k_a) == set(k_b)
+        perm = [k_a.index(k) for k in k_b]
+        assert np.array_equal(m_a[perm], m_b)
+
+    def test_kill_switch_scan_fallback(self, monkeypatch):
+        from kyverno_tpu.runtime.background import BackgroundScanner
+
+        monkeypatch.setenv("KTPU_INCREMENTAL", "0")
+        sc = BackgroundScanner([_policy("a", PATTERN_POOL[0],
+                                        background=True)])
+        sc.scan([_pod(i) for i in range(4)])
+        assert sc.verdict_matrix() is None
+        r = sc.delta_scan()
+        assert not r.delta
+
+
+class TestCorruptedSpliceCaught:
+    """ISSUE 4 fix: ``kyverno-tpu lint`` validates the incremental
+    tensor set — a splice with corrupted rebased offsets must trip
+    KT304, never reach evaluation silently."""
+
+    def _assembled(self):
+        from kyverno_tpu.models.engine import IncrementalCompiler
+
+        rng = random.Random(3)
+        inc = IncrementalCompiler()
+        cps = inc.refresh([_policy(f"p{i}", rng.choice(PATTERN_POOL))
+                           for i in range(4)])
+        return cps.tensors
+
+    def test_clean_assembly_has_no_kt304(self):
+        from kyverno_tpu.analysis.invariants import check_tensors
+
+        t = self._assembled()
+        assert t.segments
+        assert not [d for d in check_tensors(t) if d.code == "KT304"]
+
+    def test_shifted_rule_base_caught(self):
+        import dataclasses
+
+        from kyverno_tpu.analysis.invariants import check_tensors
+
+        t = self._assembled()
+        t.segments[1] = dataclasses.replace(t.segments[1],
+                                            rule_base=t.segments[1].rule_base
+                                            + 1)
+        assert [d for d in check_tensors(t) if d.code == "KT304"]
+
+    def test_cross_segment_row_reference_caught(self):
+        from kyverno_tpu.analysis.invariants import check_tensors
+
+        t = self._assembled()
+        # point one of segment 0's checks at a rule owned by segment 1 —
+        # exactly the corruption a mis-rebased splice would produce
+        span = t.segments[0]
+        lo, n = span.chk
+        assert n > 0
+        t.chk_rule[lo] = t.segments[1].rule_base
+        diags = [d for d in check_tensors(t) if d.code == "KT304"]
+        assert diags, "cross-segment rule reference must be caught"
+
+    def test_overlapping_spans_caught(self):
+        import dataclasses
+
+        from kyverno_tpu.analysis.invariants import check_tensors
+
+        t = self._assembled()
+        lo, n = t.segments[1].chk
+        t.segments[1] = dataclasses.replace(t.segments[1], chk=(lo - 1, n))
+        assert [d for d in check_tensors(t) if d.code == "KT304"]
+
+    def test_analyzer_covers_incremental_assembly(self, monkeypatch):
+        """analyze_policies lints the segmented assembly whenever the
+        runtime would serve it (KTPU_INCREMENTAL on)."""
+        from kyverno_tpu.analysis import analyzer
+        from kyverno_tpu.analysis.diagnostics import Severity
+
+        policies = [_policy(f"p{i}", PATTERN_POOL[i]) for i in range(3)]
+        report = analyzer.analyze_policies(policies)
+        assert not report.by_severity(Severity.ERROR)
+
+        seen = []
+        orig = analyzer._check_incremental
+
+        def spy(pols):
+            out = orig(pols)
+            seen.append(len(out))
+            return out
+
+        monkeypatch.setattr(analyzer, "_check_incremental", spy)
+        analyzer.analyze_policies(policies)
+        assert seen == [0]
+
+        # with the kill switch thrown there is no segmented set to lint
+        monkeypatch.setenv("KTPU_INCREMENTAL", "0")
+        assert analyzer._check_incremental(policies) == []
