@@ -833,9 +833,8 @@ def bench_config3(jax):
     # resolve the HOST cells the way a deployment must: one batched
     # oracle pass, timed — config [3] is "validate the library against
     # 10k resources", not "validate the device-scorable subset"
-    resolved = verdicts.copy()
     t0 = time.monotonic()
-    cps.resolve_host_cells(resources, resolved)
+    resolved = cps.resolve_host_cells(resources, verdicts, copy=True)
     resolve_s = time.monotonic() - t0
     residual = int((resolved == Verdict.HOST).sum())
 
@@ -1339,6 +1338,183 @@ def bench_config6(jax):
     }
 
 
+def bench_config7(jax):
+    """Host-heavy mix (round 8): a library where >= 30% of the rules are
+    host-only ({{request.object.*}} inside the pattern), so the
+    CPU-oracle tail — not the device lattice — dominates the dataflow.
+    A/B of the same flatten -> async dispatch -> resolve chain:
+
+      - serial lane: every KTPU_HOST_* kill switch thrown, i.e. the old
+        dataflow — device verdicts materialize first, then the serial
+        per-resource oracle walk resolves the HOST cells on the caller's
+        thread
+      - overlapped lane: dispatch-time predictive prefetch + host-verdict
+        memo + fan-out (runtime/hostlane), cold pass then warm pass
+
+    Two traffic shapes: a repeated-body pool (24 distinct bodies drawn
+    1536 times — the admission-coalescing case the memo exists for) and
+    a distinct-body pool (memo-adversarial: every body unique, only
+    prefetch overlap and fan-out can help). Verdict AND message parity
+    between the lanes is asserted, not reported — a fast wrong answer
+    fails the config. Acceptance: overlapped+memoized >= 2x the serial
+    tail on repeated-body traffic."""
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.models import CompiledPolicySet
+    from kyverno_tpu.runtime import hostlane
+
+    # 10 host-only + 20 device rules = 33% host-only
+    N_HOST, N_DEVICE = 10, 20
+    docs = []
+    for k in range(N_HOST):
+        docs.append({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": f"host-echo-name-{k}"},
+            "spec": {"rules": [{
+                "name": "echo-name",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {
+                    "message": f"name mismatch ({k})",
+                    "pattern": {"metadata": {"name":
+                                "{{request.object.metadata.name}}"}}},
+            }]},
+        })
+    for k in range(N_DEVICE):
+        if k % 2:
+            docs.append({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": f"disallow-latest-{k}"},
+                "spec": {"rules": [{
+                    "name": "validate-image-tag",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": f"latest tag banned ({k})",
+                                 "pattern": {"spec": {"containers": [
+                                     {"image": "!*:latest"}]}}},
+                }]},
+            })
+        else:
+            docs.append({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": f"require-name-{k}"},
+                "spec": {"rules": [{
+                    "name": "check-name",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": f"name required ({k})",
+                                 "pattern": {"metadata": {"name": "?*"}}},
+                }]},
+            })
+    cps = CompiledPolicySet([load_policy(d) for d in docs])
+    n_live = int(cps.tensors.n_rules_live)
+    host_rules = int(np.asarray(
+        cps.tensors.rule_host_only[:n_live]).sum())
+
+    bodies = [make_pod(i) for i in range(24)]
+    repeated = [bodies[i % len(bodies)] for i in range(1536)]
+    distinct = [make_pod(10_000 + i) for i in range(768)]
+
+    SWITCHES = ("KTPU_HOST_PREFETCH", "KTPU_HOST_MEMO",
+                "KTPU_HOST_FANOUT")
+
+    def set_switches(val):
+        saved = {s: os.environ.get(s) for s in SWITCHES}
+        for s in SWITCHES:
+            os.environ[s] = val
+        return saved
+
+    def restore(saved):
+        for s, v in saved.items():
+            if v is None:
+                os.environ.pop(s, None)
+            else:
+                os.environ[s] = v
+
+    def lane(resources):
+        """One timed pass of the shared dataflow: flatten, async device
+        dispatch, dispatch-time prefetch (None with the switch thrown),
+        then resolve_host_cells joining prefetch + post-pass. The kill
+        switches alone pick serial vs overlapped."""
+        r = hostlane.resolver()
+        before = dict(r.stats)
+        memo_before = dict(hostlane.host_cache().stats())
+        msgs: dict = {}
+        t0 = time.monotonic()
+        batch = cps.flatten_packed(resources)
+        handle = cps.evaluate_device_async(batch)
+        pf = r.prefetch(cps, resources)
+        v = cps.resolve_host_cells(resources, handle.get(),
+                                   messages_out=msgs, prefetch=pf)
+        dt = time.monotonic() - t0
+        counters = _counter_delta(before, dict(r.stats))
+        memo_d = _counter_delta(memo_before,
+                                dict(hostlane.host_cache().stats()))
+        counters["host_prefetch_cells"] = counters.pop(
+            "prefetch_submitted", 0)
+        counters["host_memo_hit"] = memo_d.get("hits", 0)
+        counters["host_memo_miss"] = memo_d.get("misses", 0)
+        counters["host_resolve_overlap_s"] = round(
+            pf.overlap_s(), 4) if pf is not None else 0.0
+        return dt, np.asarray(v), msgs, counters
+
+    cps.flatten_packed(repeated[:8])   # warm the native flattener
+
+    saved = set_switches("0")
+    try:
+        lane(repeated[:48])            # XLA + oracle warm, off the clock
+        serial_rep_s, v_ser_rep, m_ser_rep, c_serial = lane(repeated)
+        serial_dist_s, v_ser_dist, m_ser_dist, _ = lane(distinct)
+    finally:
+        restore(saved)
+
+    saved = set_switches("1")
+    try:
+        hostlane.host_cache().clear()
+        cold_s, v_cold, m_cold, c_cold = lane(repeated)
+        warm_s, v_warm, m_warm, c_warm = lane(repeated)
+        dist_s, v_dist, m_dist, c_dist = lane(distinct)
+    finally:
+        restore(saved)
+
+    # parity is load-bearing: the overlapped lanes must reproduce the
+    # serial tail's verdicts AND oracle messages bit for bit
+    if not (np.array_equal(v_ser_rep, v_cold)
+            and np.array_equal(v_ser_rep, v_warm)
+            and np.array_equal(v_ser_dist, v_dist)):
+        raise AssertionError("host-lane verdict parity violated")
+    if not (m_ser_rep == m_cold == m_warm and m_ser_dist == m_dist):
+        raise AssertionError("host-lane message parity violated")
+
+    speedup_cold = serial_rep_s / max(cold_s, 1e-9)
+    speedup_warm = serial_rep_s / max(warm_s, 1e-9)
+    return {
+        "policies": N_HOST + N_DEVICE,
+        "rules": n_live,
+        "host_rules": host_rules,
+        "host_rule_pct": round(100 * host_rules / n_live, 1),
+        "verdict_parity": True,
+        "message_parity": True,
+        "serial_lane_counters": c_serial,
+        "repeated_pool": {
+            "resources": len(repeated),
+            "distinct_bodies": len(bodies),
+            "serial_tail_s": round(serial_rep_s, 3),
+            "overlapped_cold_s": round(cold_s, 3),
+            "overlapped_warm_s": round(warm_s, 3),
+            "speedup_cold": round(speedup_cold, 1),
+            "speedup_warm": round(speedup_warm, 1),
+            "target": ">= 2.0x overlapped+memoized vs serial tail",
+            "met": speedup_warm >= 2.0,
+            "counters_cold": c_cold,
+            "counters_warm": c_warm,
+        },
+        "distinct_pool": {
+            "resources": len(distinct),
+            "serial_tail_s": round(serial_dist_s, 3),
+            "overlapped_s": round(dist_s, 3),
+            "speedup": round(serial_dist_s / max(dist_s, 1e-9), 1),
+            "counters": c_dist,
+        },
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1353,7 +1529,8 @@ def main() -> None:
                     ("3_library_250x10k", bench_config3),
                     ("4_mutate_50k", bench_config4),
                     ("5_scan_1M", bench_config5),
-                    ("6_policy_update_storm", bench_config6)):
+                    ("6_policy_update_storm", bench_config6),
+                    ("7_host_heavy_mix", bench_config7)):
         if only and name.split("_")[0] not in only:
             continue
         try:

@@ -28,6 +28,9 @@ JAX_PLATFORMS=cpu python deploy/pipeline_smoke.py || rc=1
 echo "== policy-storm smoke (incremental splice parity + kill switch)"
 JAX_PLATFORMS=cpu python deploy/storm_smoke.py || rc=1
 
+echo "== host-lane parity smoke (inline vs prefetched vs memoized vs pooled)"
+JAX_PLATFORMS=cpu python deploy/host_parity_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

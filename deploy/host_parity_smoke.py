@@ -1,0 +1,170 @@
+"""Host-lane parity smoke for CI (deploy/ci_lint.sh).
+
+Resolves the same HOST cells through four lanes and fails on any
+verdict OR oracle-message difference:
+
+  1. inline     — every KTPU_HOST_* kill switch thrown: the original
+                  serial per-resource oracle walk
+  2. prefetched — dispatch-time predictive prefetch joins at scatter
+                  time (KTPU_HOST_PREFETCH), memo off
+  3. memoized   — host-verdict memo warm after a fill pass
+                  (KTPU_HOST_MEMO), answers must still match
+  4. pooled     — resolution routed through OraclePool worker
+                  processes (KTPU_HOST_FANOUT + attached pool)
+
+Fast by construction: a few host-only policies, a handful of rows, CPU
+backend — the point is the diff, not the throughput. The pooled lane
+needs worker processes to spawn and warm; when the pool cannot come up
+in this environment the lane is skipped with a note (the other three
+still gate). Exit 0 = parity, 1 = divergence.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SWITCHES = ("KTPU_HOST_PREFETCH", "KTPU_HOST_MEMO", "KTPU_HOST_FANOUT")
+
+
+def _set(prefetch, memo, fanout):
+    for s, v in zip(SWITCHES, (prefetch, memo, fanout)):
+        os.environ[s] = v
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "uid": str(i)},
+            "spec": {"containers": [{"name": "c", "image": f"nginx:1.{i}"}],
+                     "hostNetwork": i % 2 == 0}}
+
+
+def main() -> int:
+    import numpy as np
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.models import CompiledPolicySet
+    from kyverno_tpu.runtime import hostlane
+
+    policies = [load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": msg, "pattern": pattern},
+        }]},
+    }) for name, msg, pattern in (
+        ("host-echo-name", "name mismatch",
+         {"metadata": {"name": "{{request.object.metadata.name}}"}}),
+        ("host-echo-ns", "namespace mismatch",
+         {"metadata": {"namespace": "{{request.object.metadata.namespace}}"}}),
+        ("host-never", "never matches",
+         {"metadata": {"name": "{{request.object.metadata.uid}}"}}),
+    )]
+    cps = CompiledPolicySet(policies)
+    docs = [_pod(i) for i in range(16)]
+    ctxs = [{"request": {"object": d, "operation": "CREATE",
+                         "userInfo": {"username": "smoke"}}} for d in docs]
+
+    def lane(use_prefetch):
+        msgs = {}
+        v = np.asarray(cps.evaluate_device(cps.flatten_packed(docs)))
+        pf = hostlane.resolver().prefetch(cps, docs, contexts=ctxs)
+        v = cps.resolve_host_cells(docs, v, contexts=ctxs,
+                                   messages_out=msgs, prefetch=pf)
+        assert (pf is not None) == use_prefetch, \
+            f"prefetch handle mismatch (expected started={use_prefetch})"
+        return np.asarray(v), msgs
+
+    lanes = {}
+    _set("0", "0", "0")
+    lanes["inline"] = lane(use_prefetch=False)
+    _set("1", "0", "0")
+    lanes["prefetched"] = lane(use_prefetch=True)
+    _set("1", "1", "0")
+    hostlane.host_cache().clear()
+    lane(use_prefetch=True)                       # memo fill pass
+    lanes["memoized"] = lane(use_prefetch=True)
+    memo_stats = hostlane.host_cache().stats()
+
+    # pooled lane: spawn real worker processes (min_cores=1: the gate
+    # exists for production sizing, not for this smoke)
+    pool = None
+    pool_note = ""
+    try:
+        from kyverno_tpu.runtime.oracle_pool import OraclePool
+        from kyverno_tpu.runtime.policycache import PolicyCache
+
+        cache = PolicyCache()
+        for p in policies:
+            cache.add(p)
+        pool = OraclePool(min_cores=1, workers=2)
+        gen, pols = cache.snapshot()
+        pool.ensure(gen, pols)
+        deadline = time.monotonic() + 60
+        while not pool.ready(gen) and time.monotonic() < deadline:
+            time.sleep(0.25)
+        if pool.ready(gen):
+            pooled_cps = CompiledPolicySet(pols)
+            r = hostlane.resolver()
+            r.attach_pool(pool, cache)
+            _set("1", "0", "1")
+            before = r.stats["pool_cells"]
+            msgs = {}
+            v = np.asarray(pooled_cps.evaluate_device(
+                pooled_cps.flatten_packed(docs)))
+            v = pooled_cps.resolve_host_cells(docs, v, contexts=ctxs,
+                                              messages_out=msgs)
+            lanes["pooled"] = (np.asarray(v), msgs)
+            pool_note = f"pool_cells={r.stats['pool_cells'] - before}"
+        else:
+            pool_note = "pool never became ready; pooled lane skipped"
+    except Exception as e:
+        pool_note = f"pool unavailable ({type(e).__name__}: {e}); " \
+                    "pooled lane skipped"
+    finally:
+        for s in SWITCHES:
+            os.environ.pop(s, None)
+        try:
+            hostlane.resolver().attach_pool(None, None)
+            if pool is not None:
+                pool.stop()
+        except Exception:
+            pass
+
+    v_ref, m_ref = lanes["inline"]
+    if not (v_ref == int(5)).sum() == 0:  # Verdict.HOST residue
+        print("host_parity_smoke: inline lane left HOST cells unresolved",
+              file=sys.stderr)
+        return 1
+    for name, (v, m) in lanes.items():
+        if name == "inline":
+            continue
+        if not np.array_equal(v_ref, v):
+            diff = np.argwhere(v_ref != v)
+            print(f"host_parity_smoke: {name} verdict DIVERGENCE at "
+                  f"{len(diff)} cells, first {diff[:5].tolist()}",
+                  file=sys.stderr)
+            return 1
+        if m_ref != m:
+            keys = {k for k in set(m_ref) | set(m)
+                    if m_ref.get(k) != m.get(k)}
+            print(f"host_parity_smoke: {name} message DIVERGENCE at "
+                  f"{sorted(keys)[:5]}", file=sys.stderr)
+            return 1
+    if memo_stats["hits"] == 0:
+        print("host_parity_smoke: memoized lane never hit the memo",
+              file=sys.stderr)
+        return 1
+
+    print(f"host_parity_smoke: OK ({len(docs)} rows x {v_ref.shape[1]} "
+          f"rules, lanes: {', '.join(lanes)}; memo hits "
+          f"{memo_stats['hits']}; {pool_note})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,8 @@ class CompiledPolicySet:
                 self.evaluate(resources[i:i + chunk])
                 for i in range(0, len(resources), chunk)])
 
+        from ..runtime.hostlane import resolver
+
         spans = [(i, min(i + chunk, len(resources)))
                  for i in range(0, len(resources), chunk)]
         out: list[np.ndarray] = []
@@ -221,32 +223,49 @@ class CompiledPolicySet:
                 return self.flatten_packed(resources[lo:hi])
 
             pending = pool.submit(flatten_span, spans[0])
-            in_flight: list[tuple] = []   # [(span, AsyncVerdicts)]
+            in_flight: list[tuple] = []   # [(span, AsyncVerdicts, pf)]
             for k, span in enumerate(spans):
                 batch = pending.result()
                 if k + 1 < len(spans):
                     pending = pool.submit(flatten_span, spans[k + 1])
                 handle = self.evaluate_device_async(batch)
-                in_flight.append((span, handle))
+                # host-lane prefetch rides the same shadow: the chunk's
+                # statically host-only cells start oracle-resolving now
+                # and join when the chunk's verdicts materialize below
+                pf = resolver().prefetch(
+                    self, resources[span[0]:span[1]])
+                in_flight.append((span, handle, pf))
                 if len(in_flight) > 1:
-                    (lo, hi), done = in_flight.pop(0)
+                    (lo, hi), done, pf0 = in_flight.pop(0)
                     out.append(self.resolve_host_cells(
-                        resources[lo:hi], done.get()))
-            for (lo, hi), done in in_flight:
+                        resources[lo:hi], done.get(), prefetch=pf0))
+            for (lo, hi), done, pf0 in in_flight:
                 out.append(self.resolve_host_cells(resources[lo:hi],
-                                                   done.get()))
+                                                   done.get(),
+                                                   prefetch=pf0))
         return np.concatenate(out)
 
     def resolve_host_cells(self, resources: list[dict],
                            verdicts: np.ndarray,
                            contexts: list | None = None,
                            rule_filter=None,
-                           messages_out: dict | None = None) -> np.ndarray:
-        """Replace Verdict.HOST cells with CPU-oracle verdicts, in place.
+                           messages_out: dict | None = None,
+                           copy: bool = False,
+                           prefetch=None) -> np.ndarray:
+        """Replace Verdict.HOST cells with CPU-oracle verdicts.
 
         Shared by the single-chip path, the mesh path (parallel/mesh.py
         sharded_scan) and the admission flush (runtime/batch.py) so
         host-lane rules are never silently dropped.
+
+        Mutation contract: by default ``verdicts`` is resolved **in
+        place** and also returned — callers that own a freshly
+        materialized matrix (every internal path) keep the zero-copy
+        behavior. Pass ``copy=True`` when the input array is shared
+        state something else may still read (a memoized row, a persisted
+        scan matrix, an AsyncVerdicts handle another thread also
+        holds): the oracle verdicts then land in a private copy and the
+        caller's array is left untouched.
 
         ``contexts`` (optional, aligned with ``resources``) carries the
         per-resource admission payload — ``{"request", "namespace_labels",
@@ -256,7 +275,19 @@ class CompiledPolicySet:
         container of rule indices) limits resolution to eligible rules:
         cells outside it stay HOST for the caller to escalate.
         ``messages_out`` (optional dict) receives the oracle's message per
-        resolved cell, keyed ``(batch_row, rule_index)``."""
+        resolved cell, keyed ``(batch_row, rule_index)``.
+
+        ``prefetch`` (a runtime/hostlane.HostPrefetch started at device
+        dispatch time) joins here first: its verdicts scatter into cells
+        the device actually reported HOST, and whatever it didn't cover
+        resolves in the ordinary post-pass below. Resolution itself
+        delegates to runtime/hostlane (memoization + fan-out); with the
+        KTPU_HOST_* kill switches off that delegate runs this method's
+        original serial per-resource loop unchanged."""
+        if copy:
+            verdicts = verdicts.copy()
+        if prefetch is not None:
+            prefetch.apply(verdicts, messages_out)
         host_cells = np.argwhere(verdicts == Verdict.HOST)
         if host_cells.size:
             by_resource: dict[int, list[int]] = {}
@@ -264,14 +295,11 @@ class CompiledPolicySet:
                 if rule_filter is not None and int(r) not in rule_filter:
                     continue
                 by_resource.setdefault(int(b), []).append(int(r))
-            for b, rule_rows in by_resource.items():
-                context = contexts[b] if contexts is not None else None
-                oracle = self._oracle_verdicts(resources[b], rule_rows,
-                                               context=context)
-                for r, (v, msg) in oracle.items():
-                    verdicts[b, r] = v
-                    if messages_out is not None:
-                        messages_out[(b, r)] = msg
+            if by_resource:
+                from ..runtime.hostlane import resolver
+
+                resolver().resolve_rows(self, resources, by_resource,
+                                        verdicts, contexts, messages_out)
         return verdicts
 
     def _request_policy_context(self, resource: dict, payload: dict):

@@ -92,10 +92,20 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     Returns (verdicts [B, R] numpy, fails [R], passes [R]) — the mesh-scale
     replay of /root/reference/pkg/policy/existing.go:20
     processExistingResources. The per-rule counts come from the on-device
-    psum of sharded_eval_fn; when host-lane cells (Verdict.HOST) are
-    present they are resolved through the CPU oracle exactly like
-    CompiledPolicySet.evaluate and the counts recomputed over the resolved
-    matrix, so precondition/context rules are reported, not dropped.
+    psum of sharded_eval_fn; host-lane cells (Verdict.HOST) resolve
+    through the CPU oracle exactly like CompiledPolicySet.evaluate, so
+    precondition/context rules are reported, not dropped.
+
+    Host-cell resolution is per-chunk, inside the chunk's own worker
+    thread: each worker starts a host-lane prefetch for its chunk's
+    statically host-only cells at dispatch time (runtime/hostlane), joins
+    it after materializing the device verdicts, and resolves any
+    remaining HOST cells in the post-pass — instead of concatenating all
+    chunks and walking the whole matrix serially at the end. The per-rule
+    counts update incrementally from the resolved cells alone (a HOST
+    cell counted as neither fail nor pass on device, so each resolved
+    cell adds at most one), not by recomputing the sums over the full
+    concatenated matrix.
 
     Snapshots larger than ``chunk_size`` stream through a pipeline of
     ``flatten_workers`` threads, each flattening its chunk (the native
@@ -104,20 +114,39 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     on device at once (the memory bound chunking exists for) while
     transfers and evals still overlap across workers.
     """
+    from ..runtime.hostlane import resolver
+
     fn = sharded_eval_fn(cps, mesh, axis)
 
     n_live = cps.tensors.n_rules_live
+    has_host_rules = bool(
+        np.asarray(cps.tensors.rule_host_only[:n_live]).any())
 
     def eval_chunk(chunk: list[dict]):
         pb = cps.flatten_packed(chunk)
         cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, mesh.devices.size)
-        verdict, fails, passes = fn(cells, bmeta, pb.str_bytes, pb.dictv)
+        # dispatch first, then start this chunk's host prefetch: the
+        # statically host-only cells oracle-resolve in the device
+        # flight's shadow (None when disabled or no candidates)
+        out = fn(cells, bmeta, pb.str_bytes, pb.dictv)
+        pf = resolver().prefetch(cps, chunk) if has_host_rules else None
+        verdict, fails, passes = out
         # materialize here: backpressure — the worker owns its chunk until
         # the device is done with it. Slice the rule axis back to the
         # live rules: an incremental tensor set pads it to a power-of-two
         # bucket (inert rules score NOT_APPLICABLE)
-        return (np.array(verdict)[:n, :n_live], np.array(fails)[:n_live],
-                np.array(passes)[:n_live])
+        v = np.array(verdict)[:n, :n_live]
+        fails = np.array(fails)[:n_live].astype(np.int64)
+        passes = np.array(passes)[:n_live].astype(np.int64)
+        host = v == V_HOST
+        if host.any() or pf is not None:
+            bb, rr = np.nonzero(host)
+            cps.resolve_host_cells(chunk, v, prefetch=pf)
+            if bb.size:
+                vals = v[bb, rr]
+                np.add.at(fails, rr[vals == V_FAIL], 1)
+                np.add.at(passes, rr[vals == V_PASS], 1)
+        return v, fails, passes
 
     if len(resources) <= chunk_size:
         verdicts, fails, passes = eval_chunk(resources)
@@ -131,9 +160,4 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
         verdicts = np.concatenate([v for v, _, _ in outs])
         fails = np.sum([f for _, f, _ in outs], axis=0)
         passes = np.sum([p for _, _, p in outs], axis=0)
-
-    if (verdicts == V_HOST).any():
-        verdicts = cps.resolve_host_cells(resources, verdicts)
-        fails = (verdicts == V_FAIL).sum(axis=0)
-        passes = (verdicts == V_PASS).sum(axis=0)
     return verdicts, np.asarray(fails), np.asarray(passes)

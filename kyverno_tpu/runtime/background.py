@@ -222,17 +222,30 @@ class BackgroundScanner:
 
     def _scan_rows(self, resources: list[dict]):
         """Chunked flatten + device eval that also returns the split
-        flatten rows as epoch-stamped memos (one flatten serves both)."""
+        flatten rows as epoch-stamped memos (one flatten serves both).
+
+        Host-lane cells resolve per chunk — prefetch dispatched before
+        the blocking device eval, memoized post-pass after — so the
+        incremental scan reports precondition/variable rules exactly
+        like the full-scan paths instead of dropping them, and repeat
+        scans of unchanged bodies answer from the host-verdict memo."""
         from ..models.flatten import MemoRow, split_packed_rows
         from ..parallel.mesh import DEFAULT_CHUNK
+        from .hostlane import resolver
 
         tensors = self.cps.tensors
+        has_host = bool(np.asarray(
+            tensors.rule_host_only[:tensors.n_rules_live]).any())
         chunks = []
         memos: dict[tuple, object] = {}
         for i in range(0, len(resources), DEFAULT_CHUNK):
             chunk = resources[i:i + DEFAULT_CHUNK]
             batch = self.cps.flatten_packed(chunk)
-            chunks.append(np.asarray(self.cps.evaluate_device(batch)))
+            pf = resolver().prefetch(self.cps, chunk) if has_host else None
+            v = np.asarray(self.cps.evaluate_device(batch))
+            if pf is not None or (v == int(Verdict.HOST)).any():
+                v = self.cps.resolve_host_cells(chunk, v, prefetch=pf)
+            chunks.append(v)
             for r, row in zip(chunk, split_packed_rows(batch)):
                 memos[self._res_key(r)] = MemoRow(
                     row=row, n_paths=tensors.n_paths,
@@ -371,6 +384,12 @@ class BackgroundScanner:
                 state["memos"][key] = refreshed
                 rows.append(refreshed.row)
             v = np.asarray(sub.evaluate_device(splice_packed_rows(rows)))
+            if (v == int(Verdict.HOST)).any():
+                # column-pass host cells: resolved (memoized) before the
+                # verdicts persist, so the delta matrix stays comparable
+                # with the full-scan matrix bit for bit
+                bodies = [state["resources"][k] for k in state["keys"]]
+                v = sub.resolve_host_cells(bodies, v)
             for ref in sub.rule_refs:
                 state["cols"][(ref.policy.name, ref.rule.name)] = \
                     v[:, ref.rule_index].astype(np.int8)
@@ -395,6 +414,8 @@ class BackgroundScanner:
             bodies = [state["resources"][k] for k in dirty]
             batch = self.cps.flatten_packed(bodies)
             v = np.asarray(self.cps.evaluate_device(batch))
+            if (v == int(Verdict.HOST)).any():
+                v = self.cps.resolve_host_cells(bodies, v)
             split = split_packed_rows(batch)
             for j, key in enumerate(dirty):
                 idx = state["keys"].index(key)

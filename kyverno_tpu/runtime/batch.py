@@ -743,9 +743,18 @@ class AdmissionBatcher:
             # flush pool this also lets flush N+1's flatten (its own
             # worker) overlap flush N's device time.
             overlap_s = 0.0
+            host_pf = None
             if pipeline_enabled() and not cold:
                 handle = cps.evaluate_device_async(batch)
                 t_disp = time.monotonic()
+                # predictive host-lane prefetch: the flush's statically
+                # host-only cells start oracle-resolving NOW, inside the
+                # same dispatch shadow, and join at the scatter below
+                # (_resolve_flush_hosts) instead of running serially
+                # after the device verdicts land
+                if self.resolve_host_in_flush and not is_probe:
+                    host_pf = self._start_host_prefetch(cps, items,
+                                                        resources)
                 if deferred is not None:
                     self._store_deferred(deferred)
                     overlap_s = time.monotonic() - t_disp
@@ -790,7 +799,8 @@ class AdmissionBatcher:
             live = any(not fut.done() for *_, fut in items)
             if self.resolve_host_in_flush and live and not is_probe:
                 host_resolved = self._resolve_flush_hosts(
-                    cps, items, resources, verdicts, messages)
+                    cps, items, resources, verdicts, messages,
+                    prefetch=host_pf)
             flush_cells: dict[str, int] = {}
             flagged_rules: dict[str, int] = {}
             esc: dict[str, int] = {}
@@ -834,7 +844,13 @@ class AdmissionBatcher:
                                    flagged_rules, esc, n_hits=n_hits,
                                    n_miss=n_miss,
                                    overlap_s=overlap_s,
-                                   queue_depth=queue_depth)
+                                   queue_depth=queue_depth,
+                                   host_prefetch_cells=(
+                                       host_pf.applied_cells
+                                       if host_pf is not None else 0),
+                                   host_overlap_s=(
+                                       host_pf.overlap_s()
+                                       if host_pf is not None else 0.0))
         except Exception:
             for *_, fut in items:
                 if not fut.done():
@@ -862,21 +878,55 @@ class AdmissionBatcher:
             cached = cps._ktpu_host_eligible = frozenset(idx)
         return cached
 
+    def _start_host_prefetch(self, cps, items, resources):
+        """Kick off dispatch-time resolution of the flush's statically
+        host-only eligible cells (runtime/hostlane prefetch). Contexts
+        come from the waiters' ctx_cb, built lazily — only rows that
+        actually have host-only candidate rules pay the payload build.
+        Returns the HostPrefetch join handle or None (disabled, no
+        candidates, or any failure — the post-pass still covers
+        everything)."""
+        try:
+            from . import hostlane
+
+            eligible = self._host_eligible_rules(cps)
+            if not eligible:
+                return None
+
+            def context_for(b):
+                cb = items[b][1]
+                return cb() if cb is not None else None
+
+            return hostlane.resolver().prefetch(
+                cps, resources, rule_filter=eligible,
+                context_for=context_for)
+        except Exception:
+            return None
+
     def _resolve_flush_hosts(self, cps, items, resources, verdicts,
-                             messages: dict) -> int:
+                             messages: dict, prefetch=None) -> int:
         """One batched oracle pass over the flush's eligible HOST cells;
-        returns how many cells were resolved. Failures leave cells HOST
-        (the webhook's oracle lane remains the correctness backstop)."""
+        returns how many cells were resolved. A ``prefetch`` handle
+        started at dispatch time joins first (its verdicts scatter into
+        device-confirmed HOST cells only); the pass below covers
+        whatever the prefetch didn't. Failures leave cells HOST (the
+        webhook's oracle lane remains the correctness backstop)."""
         try:
             eligible = self._host_eligible_rules(cps)
             if not eligible:
                 return 0
             v_live = verdicts[:len(items)]
+            if prefetch is not None:
+                applied = prefetch.apply(v_live, messages)
+                if applied:
+                    from . import hostlane
+
+                    hostlane.resolver().note_applied(applied)
             host_cells = np.argwhere(v_live == Verdict.HOST)
             rows_with_host = sorted({int(b) for b, r in host_cells
                                      if int(r) in eligible})
             if not rows_with_host:
-                return 0
+                return len(messages)
             contexts: list = [None] * len(items)
             for b in rows_with_host:
                 cb = items[b][1]
@@ -890,13 +940,15 @@ class AdmissionBatcher:
                                    messages_out=messages)
             return len(messages)
         except Exception:
-            return 0
+            return len(messages)
 
     def _note_flush_stats(self, batch_size: int, host_resolved: int,
                           flush_cells: dict, flagged_rules: dict,
                           esc: dict, n_hits: int = 0, n_miss: int = 0,
                           overlap_s: float = 0.0,
-                          queue_depth: int = 0) -> None:
+                          queue_depth: int = 0,
+                          host_prefetch_cells: int = 0,
+                          host_overlap_s: float = 0.0) -> None:
         """Fold one flush's diagnostics into stats + the metrics registry
         (the routing split must be observable in production, not just in
         bench output)."""
@@ -924,10 +976,37 @@ class AdmissionBatcher:
             if overlap_s > 0:
                 self.stats["overlap_s_saved"] = (
                     self.stats.get("overlap_s_saved", 0.0) + overlap_s)
+            # host-lane counters (BENCH.md "Host lane"): cells answered
+            # by the dispatch-time prefetch, and oracle seconds that ran
+            # inside the device flight instead of after it
+            if host_prefetch_cells:
+                self.stats["host_prefetch_cells"] = (
+                    self.stats.get("host_prefetch_cells", 0)
+                    + host_prefetch_cells)
+            if host_overlap_s > 0:
+                self.stats["host_resolve_overlap_s"] = (
+                    self.stats.get("host_resolve_overlap_s", 0.0)
+                    + host_overlap_s)
         # cumulative memo survival (exact hits + epoch-extended rows over
         # all lookups) — the number that must stay high through a
         # policy-update storm
         memo = self._row_cache.stats()
+        host_memo_delta = (0, 0)
+        try:
+            from .hostlane import host_cache
+
+            hc = host_cache().stats()
+            with self._lock:
+                last = getattr(self, "_host_memo_last", (0, 0))
+                host_memo_delta = (hc["hits"] - last[0],
+                                   hc["misses"] - last[1])
+                self._host_memo_last = (hc["hits"], hc["misses"])
+                # process-wide host-verdict memo traffic, mirrored into
+                # stats as absolute totals (bench reads the delta)
+                self.stats["host_memo_hit"] = hc["hits"]
+                self.stats["host_memo_miss"] = hc["misses"]
+        except Exception:
+            pass
         with self._lock:
             self.stats["flatten_memo_survival_ratio"] = (
                 memo["survival_ratio"])
@@ -947,6 +1026,11 @@ class AdmissionBatcher:
             if memo["hits"] or memo["misses"]:
                 metrics_mod.record_memo_survival(reg,
                                                  memo["survival_ratio"])
+            metrics_mod.record_host_lane(
+                reg, prefetch_cells=host_prefetch_cells,
+                memo_hits=max(0, host_memo_delta[0]),
+                memo_misses=max(0, host_memo_delta[1]),
+                overlap_s=host_overlap_s)
         except Exception:
             pass
 

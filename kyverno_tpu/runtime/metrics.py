@@ -252,6 +252,33 @@ def record_dict_epoch(registry: MetricsRegistry, population: str,
                        {"population": population}, float(epoch))
 
 
+def record_host_lane(registry: MetricsRegistry, prefetch_cells: int = 0,
+                     memo_hits: int = 0, memo_misses: int = 0,
+                     overlap_s: float = 0.0, pool_cells: int = 0) -> None:
+    """Host-lane resolution counters (runtime/hostlane — BENCH.md "Host
+    lane" section). ``prefetch_cells``: HOST cells answered by the
+    dispatch-time predictive prefetch instead of the post-device pass;
+    ``memo_hits``/``memo_misses``: host-verdict memo traffic
+    (HostVerdictCache); ``overlap_s``: oracle seconds that ran inside a
+    device flight's shadow rather than on the serial tail;
+    ``pool_cells``: cells resolved by OraclePool worker processes."""
+    if prefetch_cells:
+        registry.inc_counter("kyverno_host_prefetch_cells_total", {},
+                             float(prefetch_cells))
+    if memo_hits:
+        registry.inc_counter("kyverno_host_memo_total",
+                             {"result": "hit"}, float(memo_hits))
+    if memo_misses:
+        registry.inc_counter("kyverno_host_memo_total",
+                             {"result": "miss"}, float(memo_misses))
+    if overlap_s > 0:
+        registry.inc_counter("kyverno_host_resolve_overlap_seconds_total",
+                             {}, overlap_s)
+    if pool_cells:
+        registry.inc_counter("kyverno_host_pool_cells_total", {},
+                             float(pool_cells))
+
+
 def record_screen_escalation(registry: MetricsRegistry, reason: str,
                              value: float = 1.0) -> None:
     """Why a screened admission row escalated past CLEAN — the routing

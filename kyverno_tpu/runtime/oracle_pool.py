@@ -272,6 +272,23 @@ class OraclePool:
             with self._lock:
                 self._inflight -= 1
 
+    def evaluate_payload(self, names: list[str], resource: dict,
+                         payload: dict | None, timeout_s: float = 3.0):
+        """Host-lane fan-out entry (runtime/hostlane._pool_resolve):
+        unpack an admission context payload — the
+        models/engine._request_policy_context shape ``{"request",
+        "namespace_labels", "roles", "cluster_roles",
+        "exclude_group_role"}`` — into the worker call. Same
+        None-on-miss contract as :meth:`evaluate`."""
+        payload = payload or {}
+        return self.evaluate(
+            names, resource, payload.get("request") or {},
+            payload.get("namespace_labels") or {},
+            payload.get("roles") or [],
+            payload.get("cluster_roles") or [],
+            payload.get("exclude_group_role") or [],
+            timeout_s=timeout_s)
+
     def stop(self) -> None:
         with self._lock:
             pool, self._pool = self._pool, None

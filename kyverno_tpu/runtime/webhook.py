@@ -96,6 +96,13 @@ class WebhookServer:
 
         # multicore oracle lane; dormant below OraclePool.MIN_CORES
         self.oracle_pool = OraclePool()
+        # host-lane fan-out (runtime/hostlane): eligible flush/resolve
+        # batches may route through the pool workers, generation-guarded
+        # by this policy cache
+        from .hostlane import resolver as _hostlane_resolver
+
+        _hostlane_resolver().attach_pool(self.oracle_pool,
+                                         self.policy_cache)
         self.resource_cache = (ResourceCache(client)
                                if client is not None else None)
         self.registry = registry or metrics_mod.registry()

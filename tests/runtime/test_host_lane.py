@@ -1,0 +1,323 @@
+"""Host-lane resolution battery (runtime/hostlane + resolve_host_cells).
+
+Exercises the three overlapped-resolution mechanisms — predictive
+prefetch, host-verdict memoization, pool/thread fan-out — against the
+one property that matters: every lane must reproduce the serial
+per-resource oracle walk's verdicts AND messages bit for bit, because
+the kill switches promise to restore that dataflow exactly.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models import CompiledPolicySet
+from kyverno_tpu.models.engine import Verdict
+from kyverno_tpu.runtime import hostlane
+
+
+def _host_policy(name="host-echo-name", message="name mismatch",
+                 field="name"):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "echo",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": message,
+                         "pattern": {"metadata": {field:
+                             "{{request.object.metadata." + field + "}}"}}},
+        }]},
+    })
+
+
+def _device_policy(name="no-latest"):
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest banned",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }]},
+    })
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "default",
+                         "uid": str(i)},
+            "spec": {"containers": [{"name": "c", "image": f"nginx:1.{i}"}]}}
+
+
+def _ctx(pod):
+    return {"request": {"object": pod, "operation": "CREATE",
+                        "userInfo": {"username": "t"}}}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memo():
+    hostlane.host_cache().clear()
+    yield
+    hostlane.host_cache().clear()
+
+
+@pytest.fixture
+def cps():
+    return CompiledPolicySet([_host_policy(), _device_policy(),
+                              _host_policy("host-echo-ns",
+                                           "ns mismatch", "namespace")])
+
+
+def _serial_reference(cps, pods, contexts, rule_filter):
+    """Ground truth: every switch thrown — the original serial loop."""
+    with pytest.MonkeyPatch.context() as mp:
+        for s in ("KTPU_HOST_PREFETCH", "KTPU_HOST_MEMO",
+                  "KTPU_HOST_FANOUT"):
+            mp.setenv(s, "0")
+        msgs = {}
+        v = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            contexts=contexts, rule_filter=rule_filter, messages_out=msgs)
+    return np.asarray(v), msgs
+
+
+class TestResolveHostCells:
+    @pytest.mark.parametrize("with_contexts", [False, True])
+    @pytest.mark.parametrize("with_filter", [False, True])
+    @pytest.mark.parametrize("with_messages", [False, True])
+    def test_battery(self, cps, with_contexts, with_filter, with_messages):
+        """contexts x rule_filter x messages_out, overlapped lane vs the
+        serial reference."""
+        pods = [_pod(i) for i in range(6)]
+        contexts = [_ctx(p) for p in pods] if with_contexts else None
+        host_rows = [r for r, ref in enumerate(cps.rule_refs)
+                     if "echo" in ref.policy.name]
+        rule_filter = set(host_rows[:1]) if with_filter else None
+
+        want_v, want_m = _serial_reference(cps, pods, contexts, rule_filter)
+
+        hostlane.host_cache().clear()
+        msgs = {} if with_messages else None
+        v = cps.evaluate_device(cps.flatten_packed(pods)).copy()
+        pf = hostlane.resolver().prefetch(cps, pods, contexts=contexts,
+                                          rule_filter=rule_filter)
+        got = np.asarray(cps.resolve_host_cells(
+            pods, v, contexts=contexts, rule_filter=rule_filter,
+            messages_out=msgs, prefetch=pf))
+
+        assert np.array_equal(got, want_v)
+        if with_messages:
+            assert msgs == want_m
+        if with_filter:
+            # cells outside the filter stay HOST for the caller
+            other = [r for r in host_rows if r not in rule_filter]
+            assert (got[:, other] == int(Verdict.HOST)).all()
+        else:
+            assert not (got == int(Verdict.HOST)).any()
+
+    def test_copy_flag_leaves_input_untouched(self, cps):
+        pods = [_pod(i) for i in range(3)]
+        raw = np.asarray(cps.evaluate_device(cps.flatten_packed(pods)))
+        before = raw.copy()
+        resolved = cps.resolve_host_cells(pods, raw, copy=True)
+        assert np.array_equal(raw, before)          # input untouched
+        assert resolved is not raw
+        assert not (resolved == int(Verdict.HOST)).any()
+
+        inplace = raw.copy()
+        out = cps.resolve_host_cells(pods, inplace)
+        assert out is inplace                       # default: in place
+        assert not (inplace == int(Verdict.HOST)).any()
+
+    def test_prefetch_vs_post_pass_parity(self, cps, monkeypatch):
+        """A prefetched join and the plain post-pass must agree cell for
+        cell — over-computation may be wasted, never a verdict change."""
+        monkeypatch.setenv("KTPU_HOST_MEMO", "0")
+        pods = [_pod(i) for i in range(5)]
+
+        m_post = {}
+        monkeypatch.setenv("KTPU_HOST_PREFETCH", "0")
+        assert hostlane.resolver().prefetch(cps, pods) is None
+        v_post = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m_post)
+
+        monkeypatch.setenv("KTPU_HOST_PREFETCH", "1")
+        pf = hostlane.resolver().prefetch(cps, pods)
+        assert pf is not None and pf.submitted_cells > 0
+        m_pre = {}
+        v_pre = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m_pre, prefetch=pf)
+        assert pf.applied_cells > 0
+        assert np.array_equal(np.asarray(v_post), np.asarray(v_pre))
+        assert m_post == m_pre
+
+    def test_fanout_parity(self, cps, monkeypatch):
+        monkeypatch.setenv("KTPU_HOST_MEMO", "0")
+        pods = [_pod(i) for i in range(8)]
+        monkeypatch.setenv("KTPU_HOST_FANOUT", "0")
+        m_serial = {}
+        v_serial = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m_serial)
+        monkeypatch.setenv("KTPU_HOST_FANOUT", "1")
+        before = hostlane.resolver().stats["fanout_batches"]
+        m_fan = {}
+        v_fan = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m_fan)
+        assert hostlane.resolver().stats["fanout_batches"] > before
+        assert np.array_equal(np.asarray(v_serial), np.asarray(v_fan))
+        assert m_serial == m_fan
+
+
+def _memo_delta(before, after):
+    return {k: after[k] - before[k] for k in ("hits", "misses", "expired")}
+
+
+class TestHostVerdictMemo:
+    def test_hit_after_warm(self, cps, monkeypatch):
+        monkeypatch.setenv("KTPU_HOST_MEMO", "1")
+        monkeypatch.setenv("KTPU_HOST_PREFETCH", "0")
+        pods = [_pod(i) for i in range(4)]
+        memo = hostlane.host_cache()
+        t0 = dict(memo.stats())
+
+        m1 = {}
+        v1 = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m1)
+        cold = _memo_delta(t0, memo.stats())
+        assert cold["misses"] > 0 and cold["hits"] == 0
+
+        t1 = dict(memo.stats())
+        m2 = {}
+        v2 = cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy(),
+            messages_out=m2)
+        warm = _memo_delta(t1, memo.stats())
+        assert warm["hits"] == cold["misses"]       # every cell served
+        assert warm["misses"] == 0                  # no new oracle work
+        assert np.array_equal(np.asarray(v1), np.asarray(v2))
+        assert m1 == m2
+
+    def test_kill_switch_bypasses_cache(self, cps, monkeypatch):
+        monkeypatch.setenv("KTPU_HOST_MEMO", "0")
+        memo = hostlane.host_cache()
+        t0 = dict(memo.stats())
+        pods = [_pod(i) for i in range(3)]
+        cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy())
+        d = _memo_delta(t0, memo.stats())
+        assert d["hits"] == d["misses"] == len(memo) == 0
+
+    def test_ttl_expiry(self, cps, monkeypatch):
+        monkeypatch.setenv("KTPU_HOST_MEMO", "1")
+        monkeypatch.setenv("KTPU_HOST_PREFETCH", "0")
+        memo = hostlane.host_cache()
+        monkeypatch.setattr(memo, "pure_ttl_s", 0.02)
+        monkeypatch.setattr(memo, "context_ttl_s", 0.02)
+        pods = [_pod(0)]
+        t0 = dict(memo.stats())
+        cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy())
+        assert _memo_delta(t0, memo.stats())["misses"] > 0
+        time.sleep(0.05)
+        t1 = dict(memo.stats())
+        cps.resolve_host_cells(
+            pods, cps.evaluate_device(cps.flatten_packed(pods)).copy())
+        d = _memo_delta(t1, memo.stats())
+        assert d["expired"] > 0                     # entries aged out
+        assert d["hits"] == 0                       # and did not serve
+
+    def test_policy_swap_invalidates(self, monkeypatch):
+        """Content addressing: an edited policy (same name, new raw)
+        lands in a fresh key space — memoized verdicts/messages never
+        cross policy content. The rule always FAILs (name vs uid) so the
+        policy's own message text is what the oracle reports."""
+        monkeypatch.setenv("KTPU_HOST_MEMO", "1")
+        monkeypatch.setenv("KTPU_HOST_PREFETCH", "0")
+        pods = [_pod(0)]
+        memo = hostlane.host_cache()
+
+        def mismatch_policy(message):
+            return load_policy({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": "host-name-vs-uid"},
+                "spec": {"validationFailureAction": "enforce", "rules": [{
+                    "name": "echo",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": message,
+                                 "pattern": {"metadata": {"name":
+                                     "{{request.object.metadata.uid}}"}}},
+                }]},
+            })
+
+        t0 = dict(memo.stats())
+        cps1 = CompiledPolicySet([mismatch_policy("old wording")])
+        m1 = {}
+        cps1.resolve_host_cells(
+            pods, cps1.evaluate_device(cps1.flatten_packed(pods)).copy(),
+            messages_out=m1)
+        fill = _memo_delta(t0, memo.stats())
+        assert fill["misses"] > 0
+
+        t1 = dict(memo.stats())
+        cps2 = CompiledPolicySet([mismatch_policy("new wording")])
+        m2 = {}
+        cps2.resolve_host_cells(
+            pods, cps2.evaluate_device(cps2.flatten_packed(pods)).copy(),
+            messages_out=m2)
+        d = _memo_delta(t1, memo.stats())
+        assert d["hits"] == 0                       # nothing crossed
+        assert d["misses"] > 0
+        assert any("new wording" in m for m in m2.values())
+        assert not any("new wording" in m for m in m1.values())
+
+
+class TestShardedScanHostLane:
+    def test_incremental_counts_match_full_recompute(self):
+        """Per-chunk in-worker resolution: verdicts match the single-chip
+        evaluate, and the incrementally-updated fails/passes equal a full
+        recompute over the resolved matrix."""
+        from kyverno_tpu.ops.eval import V_FAIL, V_HOST, V_PASS
+        from kyverno_tpu.parallel.mesh import make_mesh, sharded_scan
+
+        pols = [_device_policy(), _host_policy(),
+                _host_policy("host-echo-uid", "uid mismatch", "uid")]
+        cps = CompiledPolicySet(pols)
+        pods = [_pod(i) for i in range(40)]
+        mesh = make_mesh()
+
+        verdicts, fails, passes = sharded_scan(cps, pods, mesh,
+                                               chunk_size=16)
+        assert not (verdicts == V_HOST).any()
+        want = np.asarray(cps.evaluate(pods))
+        assert np.array_equal(verdicts, want[:, :verdicts.shape[1]])
+        np.testing.assert_array_equal(
+            fails, (verdicts == V_FAIL).sum(axis=0))
+        np.testing.assert_array_equal(
+            passes, (verdicts == V_PASS).sum(axis=0))
+
+    def test_kill_switch_parity(self, monkeypatch):
+        from kyverno_tpu.parallel.mesh import make_mesh, sharded_scan
+
+        pols = [_device_policy(), _host_policy()]
+        cps = CompiledPolicySet(pols)
+        pods = [_pod(i) for i in range(24)]
+        mesh = make_mesh()
+
+        v_on, f_on, p_on = sharded_scan(cps, pods, mesh, chunk_size=8)
+        for s in ("KTPU_HOST_PREFETCH", "KTPU_HOST_MEMO",
+                  "KTPU_HOST_FANOUT"):
+            monkeypatch.setenv(s, "0")
+        v_off, f_off, p_off = sharded_scan(cps, pods, mesh, chunk_size=8)
+        assert np.array_equal(v_on, v_off)
+        np.testing.assert_array_equal(f_on, f_off)
+        np.testing.assert_array_equal(p_on, p_off)

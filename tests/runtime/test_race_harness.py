@@ -547,3 +547,83 @@ class TestIncrementalChurnRaces:
         want = fresh.evaluate_device(fresh.flatten_packed(docs))
         assert got.shape == want.shape
         assert np.array_equal(got, want)
+
+
+class TestHostMemoRaces:
+    def test_flushes_vs_policy_swap_invalidating_host_memo(self):
+        """The host-lane memo (ISSUE 5) under fire: concurrent screens
+        whose flushes resolve HOST cells — prefetched, memoized, fanned
+        out — racing policy-cache swaps that re-content a host-only
+        policy (same name, new raw) and therefore rotate its memo key
+        space mid-burst. Invariants: no exceptions/deadlock; a pod that
+        violates the host rule in EVERY generation is never screened
+        CLEAN (a memoized verdict crossing a policy swap would be
+        exactly that); and at quiescence a fresh resolution reports the
+        FINAL policy content's message — nothing memoized under an older
+        wording leaks forward."""
+        from kyverno_tpu.runtime import hostlane
+        from kyverno_tpu.runtime.batch import CLEAN, AdmissionBatcher
+        from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+        def host_policy(message):
+            # name vs uid never match, so this rule FAILs for every pod
+            # below in every generation; only the message wording moves
+            return load_policy({
+                "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+                "metadata": {"name": "host-name-vs-uid"},
+                "spec": {"validationFailureAction": "enforce", "rules": [{
+                    "name": "echo",
+                    "match": {"resources": {"kinds": ["Pod"]}},
+                    "validate": {"message": message,
+                                 "pattern": {"metadata": {"name":
+                                     "{{request.object.metadata.uid}}"}}},
+                }]},
+            })
+
+        cache = PolicyCache()
+        cache.add(_policy("block-latest"))
+        cache.add(host_policy("swapgen-0"))
+        batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0)
+        hostlane.host_cache().clear()
+
+        def pod(i):
+            # small body space: repeated bodies → real host-memo hits
+            return {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"p{i % 4}", "namespace": "default",
+                                 "uid": f"u{i % 4}"},
+                    "spec": {"containers": [{"name": "c",
+                                             "image": "nginx:1.21"}]}}
+
+        def screen(i):
+            status, _ = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                       "default", pod(i))
+            assert status != CLEAN
+
+        def swap(i):
+            cache.add(host_policy(f"swapgen-{i % 3 + 1}"))
+
+        try:
+            errors = race([screen, screen, screen, swap], duration_s=1.5)
+        finally:
+            batcher.stop()
+        assert not errors, errors[:3]
+
+        # quiescent content-crossing probe: one final deterministic swap,
+        # then a fresh resolution of a body the memo served all burst —
+        # the message must carry the final wording, never an older one
+        cache.add(host_policy("swapgen-final"))
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        body = pod(0)
+        msgs: dict = {}
+        v = cps.resolve_host_cells(
+            [body], cps.evaluate_device(cps.flatten_packed([body])).copy(),
+            messages_out=msgs)
+        from kyverno_tpu.models.engine import Verdict
+
+        assert not (np.asarray(v) == int(Verdict.HOST)).any()
+        swapped = [m for m in msgs.values() if "swapgen-" in m]
+        assert swapped and all("swapgen-final" in m for m in swapped), msgs
