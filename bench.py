@@ -772,6 +772,49 @@ def bench_config2(jax):
     parity = all(np.array_equal(a, b)
                  for a, b in zip(serial_verdicts, pipe_verdicts))
 
+    # tracing overhead A/B (acceptance: <=2% with tracing on): the
+    # instrumented evaluate_pipelined dataflow at this config's window
+    # geometry (W windows of B rows, one trace per window, spans on
+    # flatten / dispatch / host resolve) with the recorder on (default)
+    # vs the KTPU_TRACE=0 kill switch. Estimator: interleaved pairs,
+    # best-of-2 per lane per pair, median of the per-pair ratios —
+    # pairing cancels machine drift and the median rejects the multi-ms
+    # scheduler excursions that swamp a percent-level effect in means.
+    trace_docs = [p for w in windows for p in w]
+
+    def tracing_run(flag: str) -> float:
+        os.environ["KTPU_TRACE"] = flag
+        best = float("inf")
+        for _ in range(2):
+            t1 = time.monotonic()
+            np.asarray(cps.evaluate_pipelined(trace_docs, chunk=B))
+            best = min(best, time.monotonic() - t1)
+        return best
+
+    prev = os.environ.pop("KTPU_TRACE", None)
+    try:
+        os.environ["KTPU_TRACE"] = "1"
+        v_on = np.asarray(cps.evaluate_pipelined(trace_docs, chunk=B))
+        os.environ["KTPU_TRACE"] = "0"
+        v_off = np.asarray(cps.evaluate_pipelined(trace_docs, chunk=B))
+        ratios, trace_on, trace_off = [], [], []
+        for i in range(8):
+            if i % 2:                    # alternate pair order
+                off_s = tracing_run("0")
+                on_s = tracing_run("1")
+            else:
+                on_s = tracing_run("1")
+                off_s = tracing_run("0")
+            ratios.append(on_s / off_s)
+            trace_on.append(on_s)
+            trace_off.append(off_s)
+    finally:
+        os.environ.pop("KTPU_TRACE", None)
+        if prev is not None:
+            os.environ["KTPU_TRACE"] = prev
+    trace_on_s, trace_off_s = min(trace_on), min(trace_off)
+    trace_overhead_pct = (statistics.median(ratios) - 1) * 100
+
     n_rules = int(cps.tensors.n_rules)
     validations = B * n_rules
     return {
@@ -795,6 +838,13 @@ def bench_config2(jax):
             "overlap_s_saved": round(serial_s - pipe_s, 3),
             "speedup": round(serial_s / pipe_s, 3),
             "verdict_parity": parity,
+        },
+        "tracing": {
+            "on_s": round(trace_on_s, 4),
+            "off_s": round(trace_off_s, 4),
+            "overhead_pct": round(trace_overhead_pct, 2),
+            "within_2pct": trace_overhead_pct <= 2.0,
+            "verdict_parity": bool(np.array_equal(v_on, v_off)),
         },
         "verdict_histogram": {
             str(k): int(v)

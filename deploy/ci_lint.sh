@@ -31,6 +31,9 @@ JAX_PLATFORMS=cpu python deploy/storm_smoke.py || rc=1
 echo "== host-lane parity smoke (inline vs prefetched vs memoized vs pooled)"
 JAX_PLATFORMS=cpu python deploy/host_parity_smoke.py || rc=1
 
+echo "== tracing smoke (verdict parity on/off, stage coverage, /metrics parse)"
+JAX_PLATFORMS=cpu python deploy/trace_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

@@ -1,0 +1,186 @@
+"""Tracing smoke for CI (deploy/ci_lint.sh).
+
+Drives an admission burst through :class:`AdmissionBatcher` twice —
+tracing on (default) and ``KTPU_TRACE=0`` — and fails if:
+
+1. the verdicts differ (the recorder must be a pure observer),
+2. any traced admission is missing a pipeline stage (flatten, coalesce
+   wait, device dispatch/compile, host lane, scatter),
+3. any span is an orphan (falls outside its trace's [start, end] window
+   or carries a negative duration),
+4. the ``/metrics`` exposition fails a minimal text-0.0.4 parse, or its
+   stage histograms are missing the cumulative ``le=`` / ``+Inf`` lines.
+
+Fast by construction: one policy, a few dozen admissions, CPU backend.
+Exit 0 = OK, 1 = any gate failed.
+"""
+
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# stages every live (non-probe) flush-served admission must traverse;
+# device_dispatch and xla_compile are alternates for the same boundary
+REQUIRED_STAGES = ("coalesce_wait", "flatten", "host_resolve", "scatter")
+
+# text 0.0.4 sample line: name{labels} value  (labels optional)
+_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'            # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' [0-9eE.+-]+(?:[iI]nf)?$')
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 5 == 0
+                                               else f"nginx:1.{i}")}]}}
+
+
+def _burst(n=48, rec=None):
+    """Screen n pods through one batcher — each screen inside its own
+    admission trace when ``rec`` is given. Returns the verdict list."""
+    import concurrent.futures
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime import tracing
+    from kyverno_tpu.runtime.batch import AdmissionBatcher
+    from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+    cache = PolicyCache()
+    cache.add(load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "no-latest"},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "m", "pattern": {
+                "spec": {"containers": [{"image": "!*:latest"}]}}},
+        }]},
+    }))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               dispatch_cost_init_s=0.0,
+                               oracle_cost_init_s=1.0,
+                               cold_flush_fallback=False,
+                               result_cache_ttl_s=0.0)
+
+    def one(i):
+        if rec is None:
+            return batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                  "default", _pod(i))
+        t = rec.start("admission", i=i)
+        with tracing.active(t):
+            out = batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                 "default", _pod(i))
+        rec.finish(t)
+        return out
+
+    try:
+        # warm one admission so the burst takes the warm async lane
+        batcher.screen(PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                       _pod(1000))
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            return list(ex.map(one, range(n)))
+    finally:
+        batcher.stop()
+
+
+def _traced_burst(n=48):
+    from kyverno_tpu.runtime import tracing
+
+    rec = tracing.recorder()
+    rec.clear()
+    verdicts = _burst(n, rec=rec)
+    admissions = [t for t in rec.traces(4 * n) if t.kind == "admission"]
+    return verdicts, admissions
+
+
+def main() -> int:
+    from kyverno_tpu.runtime import obs_http
+
+    os.environ.pop("KTPU_TRACE", None)
+    traced, admissions = _traced_burst()
+
+    os.environ["KTPU_TRACE"] = "0"
+    try:
+        untraced = _burst()
+    finally:
+        os.environ.pop("KTPU_TRACE", None)
+
+    # gate 1: verdict parity — tracing must not change a single verdict
+    if traced != untraced:
+        bad = sum(1 for a, b in zip(traced, untraced) if a != b)
+        print(f"trace_smoke: VERDICT DIVERGENCE on {bad} admissions "
+              f"with tracing on vs off", file=sys.stderr)
+        return 1
+
+    # gate 2: stage coverage — every traced admission shows the pipeline
+    if not admissions:
+        print("trace_smoke: no admission traces recorded", file=sys.stderr)
+        return 1
+    for t in admissions:
+        names = t.stage_names()
+        missing = [s for s in REQUIRED_STAGES if s not in names]
+        if "device_dispatch" not in names and "xla_compile" not in names:
+            missing.append("device_dispatch|xla_compile")
+        if missing:
+            print(f"trace_smoke: trace {t.trace_id} missing stages "
+                  f"{missing} (has {sorted(names)})", file=sys.stderr)
+            return 1
+
+    # gate 3: no orphan spans — every span inside its trace's window,
+    # with a non-negative duration
+    for t in admissions:
+        for s in t.spans:
+            if s.t1 < s.t0 - 1e-9:
+                print(f"trace_smoke: span {s.name} negative duration",
+                      file=sys.stderr)
+                return 1
+            if s.t0 < t.t_start - 1e-6 or s.t1 > t.t_end + 1e-6:
+                print(f"trace_smoke: ORPHAN span {s.name} outside trace "
+                      f"{t.trace_id} window", file=sys.stderr)
+                return 1
+
+    # gate 4: /metrics parses under a minimal text-0.0.4 parser and the
+    # stage histogram exposes cumulative le= buckets ending in +Inf
+    status, body, ctype = obs_http.handle_obs_get("/metrics")
+    if status != 200 or not ctype.startswith("text/plain"):
+        print("trace_smoke: /metrics did not serve text/plain 200",
+              file=sys.stderr)
+        return 1
+    text = body.decode()
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line or line.startswith("#"):
+            continue
+        if not _LINE.match(line):
+            print(f"trace_smoke: /metrics line {ln} fails text-format "
+                  f"parse: {line!r}", file=sys.stderr)
+            return 1
+    buckets = [l for l in text.splitlines()
+               if l.startswith("kyverno_stage_duration_seconds_bucket")]
+    if not buckets or not any('le="+Inf"' in l for l in buckets):
+        print("trace_smoke: stage histogram missing _bucket/+Inf lines",
+              file=sys.stderr)
+        return 1
+
+    # sanity: the chrome export of the burst is valid JSON
+    from kyverno_tpu.runtime import tracing
+
+    doc = json.loads(json.dumps(tracing.recorder().chrome_trace(16)))
+    n_events = len(doc["traceEvents"])
+
+    n_spans = sum(len(t.spans) for t in admissions)
+    print(f"trace_smoke: OK ({len(admissions)} admission traces, "
+          f"{n_spans} spans, verdict parity on/off, "
+          f"{len(buckets)} stage bucket lines, "
+          f"{n_events} chrome events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

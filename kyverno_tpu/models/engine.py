@@ -8,6 +8,7 @@ batch on device and routes host-lane rules/resources through the CPU oracle
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from enum import IntEnum
 
@@ -211,38 +212,67 @@ class CompiledPolicySet:
                 self.evaluate(resources[i:i + chunk])
                 for i in range(0, len(resources), chunk)])
 
+        from ..runtime import tracing
         from ..runtime.hostlane import resolver
 
+        rec = tracing.recorder()
         spans = [(i, min(i + chunk, len(resources)))
                  for i in range(0, len(resources), chunk)]
+        traces: list = [None] * len(spans)
         out: list[np.ndarray] = []
+
+        def drain(entry):
+            """Materialize one in-flight chunk: device join, host-lane
+            resolve, trace seal."""
+            (lo, hi), done, pf0, tr0, d00 = entry
+            verdicts = done.get()
+            rec.add_span(tr0, "device_dispatch", d00, time.perf_counter(),
+                         lane="async", rows=hi - lo)
+            h0 = time.perf_counter()
+            with tracing.active(tr0):
+                out.append(self.resolve_host_cells(
+                    resources[lo:hi], verdicts, prefetch=pf0))
+            rec.add_span(tr0, "host_resolve", h0, time.perf_counter(),
+                         lane="prefetch" if pf0 is not None else "post_pass")
+            rec.finish(tr0)
+
         with ThreadPoolExecutor(max_workers=1,
                                 thread_name_prefix="ktpu-prefetch") as pool:
-            def flatten_span(span):
+            def flatten_span(span, tr):
                 lo, hi = span
-                return self.flatten_packed(resources[lo:hi])
+                f0 = time.perf_counter()
+                batch = self.flatten_packed(resources[lo:hi])
+                rec.add_span(tr, "flatten", f0, time.perf_counter(),
+                             rows=hi - lo, lane="prefetch_thread")
+                return batch
 
-            pending = pool.submit(flatten_span, spans[0])
-            in_flight: list[tuple] = []   # [(span, AsyncVerdicts, pf)]
+            traces[0] = rec.start("scan_chunk", lo=spans[0][0],
+                                  hi=spans[0][1])
+            pending = pool.submit(flatten_span, spans[0], traces[0])
+            # [(span, AsyncVerdicts, pf, trace, dispatch_t0)]
+            in_flight: list[tuple] = []
             for k, span in enumerate(spans):
+                tr = traces[k]
                 batch = pending.result()
                 if k + 1 < len(spans):
-                    pending = pool.submit(flatten_span, spans[k + 1])
+                    traces[k + 1] = rec.start(
+                        "scan_chunk", lo=spans[k + 1][0],
+                        hi=spans[k + 1][1])
+                    pending = pool.submit(flatten_span, spans[k + 1],
+                                          traces[k + 1])
+                d0 = time.perf_counter()
                 handle = self.evaluate_device_async(batch)
                 # host-lane prefetch rides the same shadow: the chunk's
                 # statically host-only cells start oracle-resolving now
                 # and join when the chunk's verdicts materialize below
-                pf = resolver().prefetch(
-                    self, resources[span[0]:span[1]])
-                in_flight.append((span, handle, pf))
+                with tracing.active(tr):
+                    pf = resolver().prefetch(
+                        self, resources[span[0]:span[1]])
+                in_flight.append((span, handle, pf, tr, d0))
                 if len(in_flight) > 1:
-                    (lo, hi), done, pf0 = in_flight.pop(0)
-                    out.append(self.resolve_host_cells(
-                        resources[lo:hi], done.get(), prefetch=pf0))
-            for (lo, hi), done, pf0 in in_flight:
-                out.append(self.resolve_host_cells(resources[lo:hi],
-                                                   done.get(),
-                                                   prefetch=pf0))
+                    drain(in_flight.pop(0))
+            for entry in in_flight:
+                drain(entry)
         return np.concatenate(out)
 
     def resolve_host_cells(self, resources: list[dict],

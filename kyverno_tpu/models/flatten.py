@@ -27,6 +27,7 @@ dedup that makes the string path cheap on device.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -571,6 +572,9 @@ def split_packed_rows(batch: PackedBatch) -> list[PackedRow]:
     the batch's verdicts exactly (dictionary value lanes are pure
     functions of the interned string and class-gated on read, so the
     re-merged table can only differ in lanes the kernels never read)."""
+    from ..runtime import tracing
+
+    _t0 = time.perf_counter()
     cells, bmeta = np.asarray(batch.cells), np.asarray(batch.bmeta)
     str_bytes, dictv = np.asarray(batch.str_bytes), np.asarray(batch.dictv)
     rows: list[PackedRow] = []
@@ -591,6 +595,9 @@ def split_packed_rows(batch: PackedBatch) -> list[PackedRow]:
             str_bytes=np.ascontiguousarray(str_bytes[ids]),
             dictv=np.ascontiguousarray(dictv[ids]),
         ))
+    tracing.recorder().add_span(
+        tracing.current(), "row_split", _t0, time.perf_counter(),
+        rows=len(rows))
     return rows
 
 
@@ -602,6 +609,9 @@ def splice_packed_rows(rows: list[PackedRow]) -> PackedBatch:
     and duplicate dictionary rows merge by elementwise OR, which is exact
     because value lanes are pure functions of the string (lanes set by two
     rows agree; lanes set by neither stay zero)."""
+    from ..runtime import tracing
+
+    _t0 = time.perf_counter()
     B = len(rows)
     P = int(rows[0].cells.shape[0]) if B else 0
     E = max([int(r.cells.shape[1]) for r in rows], default=0)
@@ -636,6 +646,8 @@ def splice_packed_rows(rows: list[PackedRow]) -> PackedBatch:
     else:
         str_bytes = np.zeros((1, STR_LEN), dtype=np.uint8)
         dictv = np.zeros((1, 5), dtype=np.uint32)
+    tracing.recorder().add_span(
+        tracing.current(), "row_splice", _t0, time.perf_counter(), rows=B)
     return PackedBatch(n=B, e=E, cells=cells, bmeta=bmeta,
                        str_bytes=str_bytes, dictv=dictv)
 

@@ -11,6 +11,7 @@ the ReportChangeRequest fan-in (/root/reference/pkg/policyreport).
 
 from __future__ import annotations
 
+import time
 from dataclasses import replace
 
 import jax
@@ -114,39 +115,62 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     on device at once (the memory bound chunking exists for) while
     transfers and evals still overlap across workers.
     """
+    from ..runtime import tracing
     from ..runtime.hostlane import resolver
 
     fn = sharded_eval_fn(cps, mesh, axis)
+    rec = tracing.recorder()
 
     n_live = cps.tensors.n_rules_live
     has_host_rules = bool(
         np.asarray(cps.tensors.rule_host_only[:n_live]).any())
 
     def eval_chunk(chunk: list[dict]):
-        pb = cps.flatten_packed(chunk)
-        cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, mesh.devices.size)
-        # dispatch first, then start this chunk's host prefetch: the
-        # statically host-only cells oracle-resolve in the device
-        # flight's shadow (None when disabled or no candidates)
-        out = fn(cells, bmeta, pb.str_bytes, pb.dictv)
-        pf = resolver().prefetch(cps, chunk) if has_host_rules else None
-        verdict, fails, passes = out
-        # materialize here: backpressure — the worker owns its chunk until
-        # the device is done with it. Slice the rule axis back to the
-        # live rules: an incremental tensor set pads it to a power-of-two
-        # bucket (inert rules score NOT_APPLICABLE)
-        v = np.array(verdict)[:n, :n_live]
-        fails = np.array(fails)[:n_live].astype(np.int64)
-        passes = np.array(passes)[:n_live].astype(np.int64)
-        host = v == V_HOST
-        if host.any() or pf is not None:
-            bb, rr = np.nonzero(host)
-            cps.resolve_host_cells(chunk, v, prefetch=pf)
-            if bb.size:
-                vals = v[bb, rr]
-                np.add.at(fails, rr[vals == V_FAIL], 1)
-                np.add.at(passes, rr[vals == V_PASS], 1)
-        return v, fails, passes
+        # each chunk is one trace: chunks run on pool worker threads, so
+        # the trace is created (and bound for hostlane attribution) here
+        tr = rec.start("scan_chunk", rows=len(chunk), lane="mesh")
+        tok = tracing.bind(tr) if tr is not None else None
+        try:
+            f0 = time.perf_counter()
+            pb = cps.flatten_packed(chunk)
+            cells, bmeta, n = pad_packed(pb.cells, pb.bmeta,
+                                         mesh.devices.size)
+            rec.add_span(tr, "flatten", f0, time.perf_counter(),
+                         rows=len(chunk), lane="worker")
+            # dispatch first, then start this chunk's host prefetch: the
+            # statically host-only cells oracle-resolve in the device
+            # flight's shadow (None when disabled or no candidates)
+            d0 = time.perf_counter()
+            out = fn(cells, bmeta, pb.str_bytes, pb.dictv)
+            pf = resolver().prefetch(cps, chunk) if has_host_rules else None
+            verdict, fails, passes = out
+            # materialize here: backpressure — the worker owns its chunk
+            # until the device is done with it. Slice the rule axis back
+            # to the live rules: an incremental tensor set pads it to a
+            # power-of-two bucket (inert rules score NOT_APPLICABLE)
+            v = np.array(verdict)[:n, :n_live]
+            fails = np.array(fails)[:n_live].astype(np.int64)
+            passes = np.array(passes)[:n_live].astype(np.int64)
+            rec.add_span(tr, "device_dispatch", d0, time.perf_counter(),
+                         lane="mesh", rows=len(chunk))
+            host = v == V_HOST
+            if host.any() or pf is not None:
+                h0 = time.perf_counter()
+                bb, rr = np.nonzero(host)
+                cps.resolve_host_cells(chunk, v, prefetch=pf)
+                if bb.size:
+                    vals = v[bb, rr]
+                    np.add.at(fails, rr[vals == V_FAIL], 1)
+                    np.add.at(passes, rr[vals == V_PASS], 1)
+                rec.add_span(tr, "host_resolve", h0, time.perf_counter(),
+                             cells=int(bb.size),
+                             lane=("prefetch" if pf is not None
+                                   else "post_pass"))
+            return v, fails, passes
+        finally:
+            if tok is not None:
+                tracing.unbind(tok)
+            rec.finish(tr)
 
     if len(resources) <= chunk_size:
         verdicts, fails, passes = eval_chunk(resources)

@@ -36,6 +36,7 @@ from ..engine.response import (
     RuleType,
 )
 from ..models import CompiledPolicySet, Verdict
+from . import tracing
 from .reports import ReportGenerator
 
 _VERDICT_TO_STATUS = {
@@ -104,7 +105,27 @@ class BackgroundScanner:
         self._events: list[tuple[str, dict]] = []
         self.delta_stats = {"full_scans": 0, "delta_scans": 0,
                             "cols_evaluated": 0, "rows_evaluated": 0}
+        self._obs = None
         self._apply_policies(policies)
+
+    def serve_observability(self, host: str = "127.0.0.1",
+                            port: int = 9464):
+        """Start the standalone /metrics //healthz //debug/traces
+        listener (runtime/obs_http.ObservabilityServer) — scanner-only
+        processes have no webhook port to scrape. Port 0 picks a free
+        port (read it back from the returned server's ``server_port``).
+        Idempotent per scanner."""
+        if self._obs is None:
+            from .obs_http import ObservabilityServer
+
+            self._obs = ObservabilityServer(host=host, port=port)
+            self._obs.start()
+        return self._obs
+
+    def stop_observability(self) -> None:
+        if self._obs is not None:
+            self._obs.stop()
+            self._obs = None
 
     # -------------------------------------------------------- policy feed
 
@@ -156,8 +177,21 @@ class BackgroundScanner:
     # --------------------------------------------------------- full scan
 
     def scan(self, resources: list[dict] | None = None) -> ScanResult:
+        rec = tracing.recorder()
+        tr = rec.start("scan")
+        tok = tracing.bind(tr) if tr is not None else None
+        try:
+            return self._scan(resources, rec, tr)
+        finally:
+            if tok is not None:
+                tracing.unbind(tok)
+            rec.finish(tr)
+
+    def _scan(self, resources, rec, tr) -> ScanResult:
         start = time.monotonic()
         resources = resources if resources is not None else self.snapshot()
+        if tr is not None:
+            tr.labels["resources"] = len(resources)
         result = ScanResult(resources_scanned=len(resources))
         self.delta_stats["full_scans"] += 1
         # a full pass supersedes any pending row dirt
@@ -169,26 +203,31 @@ class BackgroundScanner:
             return result
 
         memos = None
+        e0 = time.perf_counter()
         if self.mesh is not None:
             from ..parallel import sharded_scan
 
             verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
+            scan_lane = "mesh"
         elif self._inc is not None:
             # flatten chunk-wise and keep the split rows: the same single
             # flatten both scores this pass and seeds the delta state
             verdicts, memos = self._scan_rows(resources)
+            scan_lane = "incremental"
         else:
             from ..models.flatten import pipeline_enabled
             from ..parallel.mesh import DEFAULT_CHUNK
 
             if len(resources) <= DEFAULT_CHUNK:
                 verdicts = self.cps.evaluate(resources)
+                scan_lane = "single"
             elif pipeline_enabled():
                 # scan-chunk prefetch: flatten chunk k+1 while the device
                 # scores chunk k (KTPU_FLATTEN_PIPELINE=0 falls back to
                 # the serial chunk loop below)
                 verdicts = self.cps.evaluate_pipelined(resources,
                                                       chunk=DEFAULT_CHUNK)
+                scan_lane = "pipelined"
             else:
                 # chunk huge snapshots so flatten memory stays bounded
                 import numpy as _np
@@ -196,12 +235,18 @@ class BackgroundScanner:
                 verdicts = _np.concatenate([
                     self.cps.evaluate(resources[i:i + DEFAULT_CHUNK])
                     for i in range(0, len(resources), DEFAULT_CHUNK)])
+                scan_lane = "serial_chunks"
+        rec.add_span(tr, "scan_evaluate", e0, time.perf_counter(),
+                     lane=scan_lane, rows=len(resources))
 
+        r0 = time.perf_counter()
         for b, resource in enumerate(resources):
             per_policy = self._row_responses(
                 resource, lambda ref, b=b: verdicts[b, ref.rule_index],
                 self.cps.rule_refs, result)
             result.responses.extend(per_policy.values())
+        rec.add_span(tr, "scan_responses", r0, time.perf_counter(),
+                     violations=result.violations)
 
         if memos is not None:
             keys = [self._res_key(r) for r in resources]
@@ -307,6 +352,21 @@ class BackgroundScanner:
         if self._inc is None or self._state is None or \
                 self.mesh is not None:
             return self.scan()
+        rec = tracing.recorder()
+        tr = rec.start("delta_scan")
+        tok = tracing.bind(tr) if tr is not None else None
+        try:
+            result = self._delta_scan_seeded(refresh, rec, tr)
+            if tr is not None:
+                tr.labels.update(cols=result.cols_evaluated,
+                                 rows=result.rows_evaluated)
+            return result
+        finally:
+            if tok is not None:
+                tracing.unbind(tok)
+            rec.finish(tr)
+
+    def _delta_scan_seeded(self, refresh: dict, rec, tr) -> ScanResult:
         start = time.monotonic()
         state = self._state
         result = ScanResult(delta=True)
@@ -367,6 +427,7 @@ class BackgroundScanner:
                                           refresh_packed_row,
                                           splice_packed_rows)
 
+            c0 = time.perf_counter()
             sub = self._inc.subset(changed_policies)
             rows = []
             for key in state["keys"]:
@@ -394,6 +455,9 @@ class BackgroundScanner:
                 state["cols"][(ref.policy.name, ref.rule.name)] = \
                     v[:, ref.rule_index].astype(np.int8)
                 result.cols_evaluated += 1
+            rec.add_span(tr, "column_pass", c0, time.perf_counter(),
+                         cols=result.cols_evaluated,
+                         policies=len(changed_policies))
 
         # ---- drop columns of removed policies / removed rules
         for ck in list(state["cols"]):
@@ -410,6 +474,7 @@ class BackgroundScanner:
         if dirty:
             from ..models.flatten import MemoRow, split_packed_rows
 
+            w0 = time.perf_counter()
             tensors = self.cps.tensors
             bodies = [state["resources"][k] for k in dirty]
             batch = self.cps.flatten_packed(bodies)
@@ -426,6 +491,8 @@ class BackgroundScanner:
                     row=split[j], n_paths=tensors.n_paths,
                     epoch=tensors.dict_epoch)
                 result.rows_evaluated += 1
+            rec.add_span(tr, "row_pass", w0, time.perf_counter(),
+                         rows=result.rows_evaluated)
 
         # ---- emit only the affected (resource, policy) responses; the
         # report store's freshest-wins merge keeps everything else

@@ -39,6 +39,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 import numpy as np
 
 from ..models import Verdict
+from . import tracing
 
 CLEAN = "clean"          # every cell PASS/SKIP/NOT_APPLICABLE
 ATTENTION = "attention"  # some cell FAIL/ERROR/HOST -> oracle lane
@@ -459,6 +460,8 @@ class AdmissionBatcher:
         time was spent. On any failure — timeout, compile error, device
         error — returns (ATTENTION, []) so the caller takes the oracle
         lane."""
+        trace = tracing.current()
+        rec = tracing.recorder()
         try:
             cps = self.policy_cache.compiled(ptype, kind, namespace)
         except Exception:
@@ -476,6 +479,9 @@ class AdmissionBatcher:
                         self.stats["cache"] = self.stats.get("cache", 0) + 1
                         self.stats["clean" if hit[1] == CLEAN
                                    else "attention"] += 1
+                    now_pc = time.perf_counter()
+                    rec.add_span(trace, "screen", now_pc, now_pc,
+                                 lane="result_cache", status=hit[1])
                     return hit[1], hit[2]
         fut: Future = Future()
         now = time.monotonic()
@@ -484,6 +490,9 @@ class AdmissionBatcher:
                 return ATTENTION, []
             if now < self._circuit_open_until:
                 self.stats["oracle"] += 1
+                now_pc = time.perf_counter()
+                rec.add_span(trace, "screen", now_pc, now_pc,
+                             lane="circuit_open", status=ORACLE)
                 return ORACLE, []
             self._arrivals.append(now)
             while self._arrivals and now - self._arrivals[0] > self.rate_window_s:
@@ -502,6 +511,9 @@ class AdmissionBatcher:
             if not joining:
                 if est_batch < self.burst_threshold:
                     self.stats["oracle"] += 1
+                    now_pc = time.perf_counter()
+                    rec.add_span(trace, "screen", now_pc, now_pc,
+                                 lane="below_burst", status=ORACLE)
                     return ORACLE, []
                 if not self._device_favored(est_batch, len(cps.policies),
                                             deadline_free):
@@ -520,10 +532,14 @@ class AdmissionBatcher:
                         b.items.append((resource, None, Future()))
                         self._lock.notify()
                     self.stats["oracle"] += 1
+                    now_pc = time.perf_counter()
+                    rec.add_span(trace, "screen", now_pc, now_pc,
+                                 lane="cost_model", status=ORACLE)
                     return ORACLE, []
             self.stats["device"] += 1
             if bucket is None:
                 bucket = self._buckets[key] = _Bucket(cps)
+            fut.ktpu_trace = trace
             bucket.items.append((resource, ctx_cb, fut))
             self._lock.notify()
             # bound the wrong-way cost: if the dispatch estimate turns out
@@ -539,6 +555,7 @@ class AdmissionBatcher:
                                     + self.window_s)
                                 * (1 + self._pending_flushes))
         wait_start = time.monotonic()
+        wait_pc = time.perf_counter()
         try:
             try:
                 status, row, device_answered = fut.result(timeout=timeout_s)
@@ -577,7 +594,17 @@ class AdmissionBatcher:
                             now2 + self.circuit_cooldown_s)
                         self.stats["circuit_open"] = (
                             self.stats.get("circuit_open", 0) + 1)
+            rec.add_span(trace, "coalesce_wait", wait_pc,
+                         time.perf_counter(), lane="timeout",
+                         status=ATTENTION)
             return ATTENTION, []
+        rec.add_span(trace, "coalesce_wait", wait_pc, time.perf_counter(),
+                     lane="device" if device_answered else "fallback",
+                     status=status)
+        if trace is not None:
+            flush_spans = getattr(fut, "ktpu_flush_spans", None)
+            if flush_spans:
+                trace.adopt_spans(flush_spans)
         with self._lock:
             if device_answered:
                 # only a flush the device actually served proves the lane
@@ -707,6 +734,10 @@ class AdmissionBatcher:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
+        rec = tracing.recorder()
+        ft = rec.start("flush", batch=len(items),
+                       probe="probe" if is_probe else "live")
+        _trace_tok = tracing.bind(ft)
         try:
             from ..models.flatten import pipeline_enabled
 
@@ -717,8 +748,13 @@ class AdmissionBatcher:
             resources = [r for r, _, _ in items]
             t0 = time.monotonic()
             cpu0 = time.thread_time()
+            fl0 = time.perf_counter()
             raw, n_hits, n_miss, deferred = self._flatten_flush(cps,
                                                                 resources)
+            rec.add_span(ft, "flatten", fl0, time.perf_counter(),
+                         memo_hits=n_hits, memo_misses=n_miss,
+                         lane=("memo" if pipeline_enabled()
+                               else "kill_switch"))
             # bucket the batch shape (pow2 + admission floor) so XLA
             # compiles once per bucket, not once per admission batch
             batch, _ = self._pad_admission(raw)
@@ -734,6 +770,8 @@ class AdmissionBatcher:
                 for *_, fut in items:
                     if not fut.done():
                         # cold-fallback release: the device did NOT answer
+                        if ft is not None:
+                            fut.ktpu_flush_spans = list(ft.spans)
                         fut.set_result((ATTENTION, [], False))
             # async dispatch (tentpole piece 3): the device starts on this
             # batch NOW; the host thread spends the flight time on work
@@ -745,6 +783,7 @@ class AdmissionBatcher:
             overlap_s = 0.0
             host_pf = None
             if pipeline_enabled() and not cold:
+                d0 = time.perf_counter()
                 handle = cps.evaluate_device_async(batch)
                 t_disp = time.monotonic()
                 # predictive host-lane prefetch: the flush's statically
@@ -756,14 +795,28 @@ class AdmissionBatcher:
                     host_pf = self._start_host_prefetch(cps, items,
                                                         resources)
                 if deferred is not None:
+                    m0 = time.perf_counter()
                     self._store_deferred(deferred)
                     overlap_s = time.monotonic() - t_disp
+                    rec.add_span(ft, "memo_store", m0, time.perf_counter(),
+                                 lane="dispatch_shadow")
                 verdicts = handle.get()
+                rec.add_span(ft, "device_dispatch", d0, time.perf_counter(),
+                             lane="async", batch=batch.n)
             else:
                 # cold flush: the "dispatch" is an XLA compile holding the
                 # host anyway — overlap buys nothing, keep it simple
+                d0 = time.perf_counter()
                 verdicts = np.asarray(cps.evaluate_device(batch))
-                self._store_deferred(deferred)
+                rec.add_span(ft, "xla_compile" if cold else "device_dispatch",
+                             d0, time.perf_counter(),
+                             lane="cold" if cold else "serial",
+                             batch=batch.n)
+                if deferred is not None:
+                    m0 = time.perf_counter()
+                    self._store_deferred(deferred)
+                    rec.add_span(ft, "memo_store", m0, time.perf_counter(),
+                                 lane="inline")
             dt = time.monotonic() - t0
             cpu_dt = time.thread_time() - cpu0
             with self._lock:
@@ -798,13 +851,22 @@ class AdmissionBatcher:
             host_resolved = 0
             live = any(not fut.done() for *_, fut in items)
             if self.resolve_host_in_flush and live and not is_probe:
+                h0 = time.perf_counter()
                 host_resolved = self._resolve_flush_hosts(
                     cps, items, resources, verdicts, messages,
                     prefetch=host_pf)
+                rec.add_span(ft, "host_resolve", h0, time.perf_counter(),
+                             cells=host_resolved,
+                             prefetch_cells=(host_pf.applied_cells
+                                             if host_pf is not None else 0),
+                             lane=("prefetch" if host_pf is not None
+                                   else "post_pass"))
             flush_cells: dict[str, int] = {}
             flagged_rules: dict[str, int] = {}
             esc: dict[str, int] = {}
+            base_spans = list(ft.spans) if ft is not None else None
             for b, (_, _, fut) in enumerate(items):
+                s0 = time.perf_counter()
                 row = []
                 clean = True
                 saw = {"host": False, "error": False, "fail": False}
@@ -839,6 +901,11 @@ class AdmissionBatcher:
                     reason = "device_fail"
                 esc[reason] = esc.get(reason, 0) + 1
                 if not fut.done():
+                    sp = rec.add_span(ft, "scatter", s0,
+                                      time.perf_counter(), row=b,
+                                      reason=reason)
+                    if base_spans is not None:
+                        fut.ktpu_flush_spans = base_spans + [sp]
                     fut.set_result((CLEAN if clean else ATTENTION, row, True))
             self._note_flush_stats(len(items), host_resolved, flush_cells,
                                    flagged_rules, esc, n_hits=n_hits,
@@ -855,6 +922,9 @@ class AdmissionBatcher:
             for *_, fut in items:
                 if not fut.done():
                     fut.set_result((ATTENTION, [], False))
+        finally:
+            tracing.unbind(_trace_tok)
+            rec.finish(ft)
 
     def _host_eligible_rules(self, cps) -> frozenset:
         """Rule indices whose policy the flush may resolve host-side:

@@ -41,6 +41,7 @@ import threading
 import time
 
 from ..models.engine import Verdict, _STATUS_TO_VERDICT
+from . import tracing
 from .resourcecache import HostVerdictCache
 
 
@@ -92,6 +93,7 @@ class HostPrefetch:
 
     def apply(self, verdicts, messages_out: dict | None = None) -> int:
         t0 = time.monotonic()
+        j0 = time.perf_counter()
         applied = 0
         n_rows = verdicts.shape[0]
         for b, fut in self._futs.items():
@@ -111,6 +113,10 @@ class HostPrefetch:
         self._futs = {}
         self.wait_s = time.monotonic() - t0
         self.applied_cells = applied
+        tracing.recorder().add_span(
+            tracing.current(), "host_join", j0, time.perf_counter(),
+            applied=applied, submitted=self.submitted_cells,
+            overlap_us=int(self.overlap_s() * 1e6), lane="prefetch")
         return applied
 
     def overlap_s(self) -> float:
@@ -234,9 +240,18 @@ class HostLaneResolver:
         if not candidates:
             return None
 
+        # the flush trace active on the dispatching thread — prefetch
+        # rows run on executor threads, so attribution is explicit
+        parent = tracing.current()
+        rec = tracing.recorder()
+
         def run(resource, rows, context):
             t0 = time.monotonic()
-            oracle = self.resolve_resource(cps, resource, rows, context)
+            p0 = time.perf_counter()
+            oracle = self.resolve_resource(cps, resource, rows, context,
+                                           trace=parent)
+            rec.add_span(parent, "host_prefetch", p0, time.perf_counter(),
+                         cells=len(rows))
             return oracle, time.monotonic() - t0
 
         ex = self.executor()
@@ -276,10 +291,11 @@ class HostLaneResolver:
             return contexts[b] if contexts is not None else None
 
         resolved = 0
+        parent = tracing.current()
         if fanout_enabled() and len(items) > 1:
             ex = self.executor()
             futs = [(b, ex.submit(self.resolve_resource, cps,
-                                  resources[b], rows, ctx(b)))
+                                  resources[b], rows, ctx(b), parent))
                     for b, rows in items]
             with self._lock:
                 self.stats["fanout_batches"] += 1
@@ -297,10 +313,16 @@ class HostLaneResolver:
         return resolved
 
     def resolve_resource(self, cps, resource: dict, rule_rows: list[int],
-                         context: dict | None) -> dict:
+                         context: dict | None, trace=None) -> dict:
         """{rule_index: (Verdict, message)} for one resource's HOST
         cells — memo lookups first, then one oracle pass (pool workers
-        when eligible, inline otherwise) for the misses."""
+        when eligible, inline otherwise) for the misses. ``trace``
+        carries the caller's trace onto executor threads (defaults to
+        the thread-local current trace)."""
+        if trace is None:
+            trace = tracing.current()
+        r0 = time.perf_counter()
+        lane = "memo"
         memo = host_cache() if memo_enabled() else None
         out: dict[int, tuple] = {}
         misses = list(rule_rows)
@@ -324,8 +346,10 @@ class HostLaneResolver:
                 else:
                     out[r] = hit
             misses = still
+        n_memo_hits = len(rule_rows) - len(misses)
         if misses:
-            fresh = self._oracle_misses(cps, resource, misses, context)
+            fresh, lane = self._oracle_misses(cps, resource, misses,
+                                              context)
             if memo is not None:
                 for r, cell in fresh.items():
                     key = keys.get(r)
@@ -336,15 +360,22 @@ class HostLaneResolver:
                            else memo.context_ttl_s)
                     memo.put(key, cell[0], cell[1], ttl)
             out.update(fresh)
+        tracing.recorder().add_span(
+            trace, "host_resolve_row", r0, time.perf_counter(),
+            cells=len(rule_rows), memo_hits=n_memo_hits,
+            misses=len(misses), lane=lane)
         return out
 
     def _oracle_misses(self, cps, resource: dict, rule_rows: list[int],
-                       context: dict | None) -> dict:
+                       context: dict | None) -> tuple[dict, str]:
+        """Returns (verdicts, lane) — lane names which oracle served the
+        misses ("pool" workers vs the "inline" engine)."""
         if fanout_enabled() and self._pool is not None:
             routed = self._pool_resolve(cps, resource, rule_rows, context)
             if routed is not None:
-                return routed
-        return cps._oracle_verdicts(resource, rule_rows, context=context)
+                return routed, "pool"
+        return cps._oracle_verdicts(resource, rule_rows,
+                                    context=context), "inline"
 
     def _pool_resolve(self, cps, resource: dict, rule_rows: list[int],
                       context: dict | None):

@@ -9,6 +9,8 @@ A periodic reset clears the registry like PromConfig's cron (metrics.go:17).
 
 from __future__ import annotations
 
+import bisect
+import platform
 import threading
 import time
 
@@ -21,6 +23,34 @@ METRIC_NAMES = (
     "kyverno_admission_requests_total",
 )
 
+# default cumulative-bucket ladder for latency histograms (seconds):
+# spans the sub-ms device dispatch through the 10s webhook deadline so
+# p50/p99 per pipeline stage are readable straight off the _bucket lines
+DEFAULT_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# per-metric ladders for histograms that aren't latencies
+BUCKET_OVERRIDES = {
+    "kyverno_admission_flush_batch_size": (
+        1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0),
+}
+
+
+def _escape_label_value(v) -> str:
+    """Text 0.0.4 label-value escaping: backslash, double-quote, newline.
+    Policy/rule names are user-controlled — an unescaped quote corrupts
+    the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_bound(b: float) -> str:
+    """le= bound formatting: integral bounds render without the trailing
+    .0 churn ("1" not "1.0" is what prometheus client_golang emits)."""
+    return f"{b:g}"
+
 
 class MetricsRegistry:
     def __init__(self):
@@ -28,8 +58,30 @@ class MetricsRegistry:
         # name -> frozenset(label items) -> value
         self._counters: dict[str, dict[frozenset, float]] = {}
         self._gauges: dict[str, dict[frozenset, float]] = {}
+        # histogram series value: [count, sum, per-bucket counts] where
+        # the per-bucket list is non-cumulative (bucket i counts values in
+        # (bound[i-1], bound[i]], last slot = > last bound); render()
+        # emits the cumulative le= form the text protocol requires
         self._histograms: dict[str, dict[frozenset, list]] = {}
+        self._buckets: dict[str, tuple] = dict(BUCKET_OVERRIDES)
         self._last_reset = time.time()
+        self._seed_static_series()
+
+    def _seed_static_series(self) -> None:
+        """Series that must exist on a fresh/reset registry: build info
+        (one constant gauge a scraper can join on) and the reset stamp —
+        the periodic PromConfig reset() is VISIBLE to scrapers instead of
+        silently zeroing counters mid-rate()."""
+        from .. import __version__
+
+        self._gauges["kyverno_tpu_build_info"] = {
+            frozenset({
+                "version": __version__,
+                "engine": "jax",
+                "python": platform.python_version(),
+            }.items()): 1.0}
+        self._gauges["kyverno_metrics_last_reset_timestamp_seconds"] = {
+            frozenset(): self._last_reset}
 
     # ------------------------------------------------------------ writes
 
@@ -43,13 +95,30 @@ class MetricsRegistry:
         with self._lock:
             self._gauges.setdefault(name, {})[frozenset((labels or {}).items())] = value
 
-    def observe(self, name: str, labels: dict | None = None, value: float = 0.0) -> None:
+    def set_buckets(self, name: str, bounds: tuple | list) -> None:
+        """Per-metric bucket-ladder override; applies to observations made
+        after the call (already-recorded series keep their shape)."""
         with self._lock:
+            self._buckets[name] = tuple(sorted(set(float(b)
+                                                   for b in bounds)))
+
+    def observe(self, name: str, labels: dict | None = None, value: float = 0.0) -> None:
+        self._observe_key(name, frozenset((labels or {}).items()), value)
+
+    def _observe_key(self, name: str, key: frozenset,
+                     value: float) -> None:
+        """observe() with a pre-built label key — the tracing feed calls
+        this once per span per trace and caches its frozensets."""
+        with self._lock:
+            bounds = self._buckets.get(name, DEFAULT_LATENCY_BUCKETS)
             series = self._histograms.setdefault(name, {})
-            key = frozenset((labels or {}).items())
-            bucket = series.setdefault(key, [0, 0.0])
-            bucket[0] += 1
-            bucket[1] += value
+            h = series.get(key)
+            if h is None or len(h[2]) != len(bounds) + 1:
+                h = series[key] = [0, 0.0, [0] * (len(bounds) + 1)]
+            h[0] += 1
+            h[1] += value
+            # bisect_left: value == bound lands in le=bound, per protocol
+            h[2][bisect.bisect_left(bounds, value)] += 1
 
     def reset(self) -> None:
         """PromConfig periodic registry reset (metrics.go:17)."""
@@ -58,18 +127,24 @@ class MetricsRegistry:
             self._gauges.clear()
             self._histograms.clear()
             self._last_reset = time.time()
+            self._seed_static_series()
 
     # ------------------------------------------------------------ reads
 
     @staticmethod
-    def _fmt_labels(key: frozenset) -> str:
-        if not key:
+    def _fmt_labels(key: frozenset, extra: str = "") -> str:
+        if not key and not extra:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(key))
+        inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                         for k, v in sorted(key))
+        if extra:
+            inner = f"{inner},{extra}" if inner else extra
         return "{" + inner + "}"
 
     def expose(self) -> str:
-        """text/plain exposition."""
+        """text/plain 0.0.4 exposition: counters, gauges, and real
+        histograms (cumulative ``_bucket`` lines with ``le=`` labels plus
+        ``+Inf``, then ``_sum``/``_count``)."""
         lines = []
         with self._lock:
             for name, series in sorted(self._counters.items()):
@@ -81,11 +156,49 @@ class MetricsRegistry:
                 for key, value in series.items():
                     lines.append(f"{name}{self._fmt_labels(key)} {value:g}")
             for name, series in sorted(self._histograms.items()):
-                lines.append(f"# TYPE {name} summary")
-                for key, (count, total) in series.items():
+                lines.append(f"# TYPE {name} histogram")
+                bounds = self._buckets.get(name, DEFAULT_LATENCY_BUCKETS)
+                for key, (count, total, per_bucket) in series.items():
+                    cum = 0
+                    for b, c in zip(bounds, per_bucket):
+                        cum += c
+                        le = 'le="' + _fmt_bound(b) + '"'
+                        lines.append(f"{name}_bucket"
+                                     f"{self._fmt_labels(key, le)} {cum:g}")
+                    inf = 'le="+Inf"'
+                    lines.append(f"{name}_bucket"
+                                 f"{self._fmt_labels(key, inf)} {count:g}")
                     lines.append(f"{name}_count{self._fmt_labels(key)} {count:g}")
                     lines.append(f"{name}_sum{self._fmt_labels(key)} {total:g}")
         return "\n".join(lines) + "\n"
+
+    # the exposition under its protocol-spec name; expose() predates it
+    def render(self) -> str:
+        return self.expose()
+
+    def histogram_quantile(self, name: str, q: float,
+                           labels: dict | None = None) -> float | None:
+        """Bucket-interpolated quantile (the PromQL histogram_quantile
+        recipe) straight off the registry — bench and the autotuner read
+        p50/p99 per stage here without scraping themselves."""
+        with self._lock:
+            series = self._histograms.get(name, {})
+            h = series.get(frozenset((labels or {}).items()))
+            if h is None or h[0] == 0:
+                return None
+            bounds = self._buckets.get(name, DEFAULT_LATENCY_BUCKETS)
+            count, _, per_bucket = h
+            rank = q * count
+            cum = 0
+            for i, c in enumerate(per_bucket):
+                cum += c
+                if cum >= rank and c:
+                    if i >= len(bounds):
+                        return bounds[-1] if bounds else None
+                    lo = bounds[i - 1] if i else 0.0
+                    frac = (rank - (cum - c)) / c
+                    return lo + (bounds[i] - lo) * frac
+            return bounds[-1] if bounds else None
 
 
 _registry = MetricsRegistry()
@@ -277,6 +390,44 @@ def record_host_lane(registry: MetricsRegistry, prefetch_cells: int = 0,
     if pool_cells:
         registry.inc_counter("kyverno_host_pool_cells_total", {},
                              float(pool_cells))
+
+
+_stage_labels_cache: dict = {}
+
+
+def record_stage_duration(registry: MetricsRegistry, stage: str,
+                          seconds: float, kind: str = "") -> None:
+    """Per-pipeline-stage latency histogram (runtime/tracing feeds one
+    observation per recorded span at trace finish). The ``stage`` label
+    is the span name — flatten / coalesce_wait / device_dispatch /
+    xla_compile / host_prefetch / host_resolve / scatter /
+    response_marshal — and ``kind`` the trace kind (admission / flush /
+    scan / scan_chunk), so `/metrics` answers "p99 of device dispatch
+    under admission load" from the ``_bucket`` lines alone. The label
+    keys are cached: this runs once per span per trace on the hot path
+    and the (stage, kind) vocabulary is a couple dozen entries."""
+    ck = (stage, kind)
+    key = _stage_labels_cache.get(ck)
+    if key is None:
+        key = _stage_labels_cache[ck] = frozenset(
+            {"stage": stage, "kind": kind}.items())
+    registry._observe_key("kyverno_stage_duration_seconds", key, seconds)
+
+
+_trace_kind_cache: dict = {}
+
+
+def record_trace(registry: MetricsRegistry, kind: str,
+                 seconds: float) -> None:
+    """One finished trace: count by kind + end-to-end duration histogram
+    (the flight recorder's scrape-side shadow)."""
+    cached = _trace_kind_cache.get(kind)
+    if cached is None:
+        cached = _trace_kind_cache[kind] = (
+            {"kind": kind}, frozenset({"kind": kind}.items()))
+    labels, key = cached
+    registry.inc_counter("kyverno_traces_total", labels)
+    registry._observe_key("kyverno_trace_duration_seconds", key, seconds)
 
 
 def record_screen_escalation(registry: MetricsRegistry, reason: str,

@@ -1,0 +1,134 @@
+"""Shared observability HTTP surface: /metrics, /healthz, /debug/traces.
+
+One routing function serves both front doors — the admission webhook
+(runtime/webhook.py mounts it inside its existing handler, so the
+kube-apiserver-facing port also answers scrapes) and a standalone
+:class:`ObservabilityServer` for processes with no webhook listener
+(the background scanner). Endpoints:
+
+``/metrics``
+    Prometheus text 0.0.4 exposition from the metrics registry —
+    including the ``kyverno_stage_duration_seconds`` bucket histograms
+    the trace recorder feeds, so per-stage p50/p99 are scrapeable.
+``/healthz``
+    JSON liveness snapshot: build version, trace-recorder counters,
+    uptime.
+``/debug/traces``
+    Flight-recorder dump (JSON). Query params: ``n`` (max traces,
+    default 32), ``slowest=1`` (the K-slowest set instead of the
+    newest), ``format=chrome`` (Chrome ``trace_event`` JSON for
+    chrome://tracing / Perfetto instead of the plain schema).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics as metrics_mod
+from . import tracing
+
+_started_at = time.time()
+
+
+def handle_obs_get(path: str, registry=None):
+    """Route one GET. Returns ``(status, body_bytes, content_type)`` or
+    ``None`` when ``path`` is not an observability endpoint (the caller
+    falls through to its own routes / 404)."""
+    parsed = urlparse(path)
+    route = parsed.path.rstrip("/") or "/"
+    if route == "/metrics":
+        # settle the recorder's deferred histogram feed before exposing
+        tracing.recorder().feed_metrics()
+        reg = registry if registry is not None else metrics_mod.registry()
+        return 200, reg.expose().encode(), "text/plain; version=0.0.4"
+    if route == "/healthz":
+        rec = tracing.recorder()
+        rec.feed_metrics()
+        body = json.dumps({
+            "status": "ok",
+            "uptime_s": round(time.time() - _started_at, 3),
+            "tracing_enabled": tracing.trace_enabled(),
+            "traces": dict(rec.stats),
+            "lanes": tracing.killswitch_lanes(),
+        }).encode()
+        return 200, body, "application/json"
+    if route == "/debug/traces":
+        q = parse_qs(parsed.query)
+
+        def _qint(name: str, default: int) -> int:
+            try:
+                return max(0, int(q[name][0]))
+            except (KeyError, IndexError, ValueError):
+                return default
+
+        n = _qint("n", 32)
+        slowest = q.get("slowest", ["0"])[0] not in ("0", "", "false")
+        rec = tracing.recorder()
+        if q.get("format", [""])[0] == "chrome":
+            payload = rec.chrome_trace(n, slowest=slowest)
+        else:
+            payload = {"enabled": tracing.trace_enabled(),
+                       "slowest": slowest,
+                       "stats": dict(rec.stats),
+                       "traces": rec.export(n, slowest=slowest)}
+        return 200, json.dumps(payload).encode(), "application/json"
+    return None
+
+
+class ObservabilityServer:
+    """Standalone /metrics //healthz //debug/traces listener for
+    processes that don't run the webhook server (background scanner,
+    bench drivers). Port 0 picks a free port; read it back from
+    ``server_port`` after :meth:`start`."""
+
+    def __init__(self, registry=None, host: str = "127.0.0.1",
+                 port: int = 9464):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def server_port(self) -> int | None:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> ThreadingHTTPServer:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                out = handle_obs_get(self.path, outer.registry)
+                if out is None:
+                    out = (404, b"not found", "text/plain")
+                status, body, ctype = out
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class Httpd(ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = Httpd((self.host, self.port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="ktpu-obs-http")
+        self._thread.start()
+        return self._httpd
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

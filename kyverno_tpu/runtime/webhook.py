@@ -33,6 +33,8 @@ from ..policy.validation import validate_policy
 from ..api.load import load_policy
 from . import batch as batch_mod
 from . import metrics as metrics_mod
+from . import obs_http
+from . import tracing
 from .config import ConfigData
 from .resourcecache import ResourceCache
 from .events import EventGenerator, events_for_engine_response
@@ -120,13 +122,30 @@ class WebhookServer:
     # ------------------------------------------------------------ dispatch
 
     def handle(self, path: str, review: dict) -> dict:
-        """server.go:244 handlerFunc: the generic wrapper."""
-        if self.admission_batcher is not None:
-            # the in-flight count is the batcher's concurrency signal for
-            # oracle-vs-device routing (runtime/batch.py)
-            with self.admission_batcher.admission_in_flight():
-                return self._handle(path, review)
-        return self._handle(path, review)
+        """server.go:244 handlerFunc: the generic wrapper. Owns the
+        admission trace unless the transport (do_POST) already started
+        one on this thread — direct in-process callers get a full trace
+        either way."""
+        rec = tracing.recorder()
+        own = None
+        if tracing.current() is None:
+            own = rec.start("admission", path=path)
+        tok = tracing.bind(own) if own is not None else None
+        try:
+            if self.admission_batcher is not None:
+                # the in-flight count is the batcher's concurrency signal
+                # for oracle-vs-device routing (runtime/batch.py)
+                with self.admission_batcher.admission_in_flight():
+                    out = self._handle(path, review)
+            else:
+                out = self._handle(path, review)
+            if own is not None:
+                own.labels["allowed"] = str(out["response"]["allowed"])
+            return out
+        finally:
+            if tok is not None:
+                tracing.unbind(tok)
+            rec.finish(own)
 
     def _handle(self, path: str, review: dict) -> dict:
         start = time.monotonic()
@@ -137,6 +156,10 @@ class WebhookServer:
         namespace = request.get("namespace", "")
         name = ((request.get("object") or {}).get("metadata") or {}).get("name", "")
         operation = request.get("operation", "CREATE")
+        trace = tracing.current()
+        if trace is not None:
+            trace.labels.update(kind=kind, namespace=namespace,
+                                operation=operation, uid=uid)
 
         # dynamic config resource filters (server.go:252)
         if path in (MUTATING_WEBHOOK_PATH, VALIDATING_WEBHOOK_PATH):
@@ -545,6 +568,10 @@ class WebhookServer:
                    if decision_key is not None else None)
             if hit is not None and hit[0] > time.monotonic():
                 _, allowed, message, rows = hit
+                now_pc = time.perf_counter()
+                tracing.recorder().add_span(
+                    tracing.current(), "screen", now_pc, now_pc,
+                    lane="decision_cache")
                 for pn, rn, sv, _msg in rows:
                     metrics_mod.record_policy_results(
                         self.registry, pn, rn, sv,
@@ -628,6 +655,7 @@ class WebhookServer:
                         self.admission_batcher.stats.get("device_decided", 0)
                         + 1)
             oracle_t0 = time.monotonic()
+            o0 = time.perf_counter()
             # multicore lane: cluster-independent policies can evaluate in
             # a worker process (runtime/oracle_pool.py) — the GIL
             # serializes the inline loop, so on a multicore host a burst
@@ -635,12 +663,17 @@ class WebhookServer:
             # goroutines do. Any miss falls through to the inline loop.
             responses = self._pool_oracle(run_policies, resource, request,
                                           namespace)
+            oracle_lane = "pool" if responses is not None else "inline"
             if responses is None:
                 responses = []
                 pctx = self._policy_context(request, resource)
                 for policy in run_policies:
                     pctx.policy = policy
                     responses.append(engine_validate(pctx))
+            tracing.recorder().add_span(
+                tracing.current(), "oracle", o0, time.perf_counter(),
+                lane=oracle_lane, policies=len(run_policies),
+                hybrid="1" if screen_row else "0")
             for policy, resp in zip(run_policies, responses):
                 for rule in resp.policy_response.rules:
                     metric_rows.append(
@@ -1019,21 +1052,38 @@ class WebhookServer:
             def do_GET(self):
                 if self.path in (LIVENESS_PATH, READINESS_PATH):
                     self._reply(200, b"ok")
-                elif self.path == "/metrics":
-                    self._reply(200, server.registry.expose().encode(),
-                                "text/plain; version=0.0.4")
+                    return
+                # /metrics, /healthz, /debug/traces (runtime/obs_http)
+                obs = obs_http.handle_obs_get(self.path, server.registry)
+                if obs is not None:
+                    status, body, ctype = obs
+                    self._reply(status, body, ctype)
                 else:
                     self._reply(404, b"")
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
+                rec = tracing.recorder()
+                trace = rec.start("admission", path=self.path,
+                                  transport="http")
+                tok = tracing.bind(trace) if trace is not None else None
                 try:
                     review = json.loads(self.rfile.read(length) or b"{}")
                     out = server.handle(self.path, review)
-                    self._reply(200, json.dumps(out).encode(),
-                                "application/json")
+                    m0 = time.perf_counter()
+                    body = json.dumps(out).encode()
+                    rec.add_span(trace, "response_marshal", m0,
+                                 time.perf_counter(), bytes=len(body))
+                    if trace is not None:
+                        trace.labels["allowed"] = str(
+                            out["response"]["allowed"])
+                    self._reply(200, body, "application/json")
                 except Exception as e:
                     self._reply(500, str(e).encode())
+                finally:
+                    if tok is not None:
+                        tracing.unbind(tok)
+                    rec.finish(trace)
 
         class Httpd(ThreadingHTTPServer):
             daemon_threads = True
