@@ -1565,6 +1565,321 @@ def bench_config7(jax):
     }
 
 
+def bench_config9(jax):
+    """Streaming plane (round 10): open-loop Poisson load. Closed-loop
+    benches understate queueing — a slow server slows its own clients —
+    so here arrivals are released by a Poisson clock regardless of
+    completions and latency is measured FROM THE SCHEDULED ARRIVAL,
+    making queue wait visible. Two lanes over the same device dataflow:
+
+      - webhook lane: distinct JSON AdmissionReview bodies over real
+        HTTP keep-alive connections, result cache off (no-cache) — the
+        per-request JSON parse + flatten + re-intern tax
+      - stream lane: pre-tokenized columnar rows over the streaming
+        frame protocol into the continuous batcher — rows splice
+        device-ready, zero re-parse/re-intern
+
+    A rate step is *sustained* when achieved/offered >= the ratio floor
+    with p99 well inside the 10s webhook deadline and no transport
+    errors; saturation is the highest sustained offered rate (the sweep
+    stops at the first unsustained step — open loop past saturation only
+    grows backlog). Verdict parity between the lanes is asserted on a
+    sample, not reported. Acceptance: stream saturation >= 2x the
+    webhook no-cache saturation."""
+    import http.client
+    import queue as queue_mod
+    import random
+    import socket
+
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime.batch import AdmissionBatcher
+    from kyverno_tpu.runtime.client import FakeCluster
+    from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+    from kyverno_tpu.runtime.stream_server import (StreamClient,
+                                                   StreamServer,
+                                                   flatten_block_for_wire,
+                                                   flatten_rows_for_wire)
+    from kyverno_tpu.runtime.webhook import (
+        VALIDATING_WEBHOOK_PATH,
+        WebhookServer,
+    )
+
+    # device-only library: every rule decidable on the lattice, so the
+    # webhook path and the columnar row path (which never takes the
+    # host-lane detour) must agree exactly
+    docs = []
+    for k in range(4):
+        docs.append({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": f"disallow-latest-{k}"},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "validate-image-tag",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": f"latest tag banned ({k})",
+                             "pattern": {"spec": {"containers": [
+                                 {"image": "!*:latest"}]}}},
+            }]},
+        })
+        docs.append({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": f"require-name-{k}"},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "check-name",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": f"name required ({k})",
+                             "pattern": {"metadata": {"name": "?*"}}},
+            }]},
+        })
+    pols = [load_policy(d) for d in docs]
+
+    def stack():
+        cache = PolicyCache()
+        for p in pols:
+            cache.add(p)
+        batcher = AdmissionBatcher(cache, window_s=0.004,
+                                   burst_threshold=1,
+                                   dispatch_cost_init_s=0.0,
+                                   oracle_cost_init_s=1.0,
+                                   cold_flush_fallback=False,
+                                   result_cache_ttl_s=0.0,
+                                   continuous=True)
+        server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                               admission_batcher=batcher)
+        return cache, batcher, server
+
+    headers = {"Content-Type": "application/json"}
+    N_WORKERS = 24
+    SUSTAIN_RATIO = 0.85
+    P99_CEIL_MS = 2_500.0          # "well inside" the 10s deadline
+    RATES = (25, 50, 100, 200, 400, 800, 1600, 3200)
+
+    def open_loop(rate, payloads, submit_factory, seed):
+        """One offered-rate step. A dispatcher thread releases work on
+        the Poisson clock into an unbounded queue (sampling its depth at
+        every release); workers drain it, so server backlog shows up as
+        latency-from-scheduled-arrival and as queue depth, never as a
+        slower arrival process."""
+        rng = random.Random(seed)
+        sched, t = [], 0.0
+        for _ in payloads:
+            t += rng.expovariate(rate)
+            sched.append(t)
+        q: queue_mod.Queue = queue_mod.Queue()
+        lock = threading.Lock()
+        lats: list = []
+        errors: list = []
+        depths: list = []
+
+        def worker():
+            submit, done = submit_factory()
+            try:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    arrival, payload = item
+                    try:
+                        submit(payload)
+                        lat = (time.perf_counter() - arrival) * 1e3
+                        with lock:
+                            lats.append(lat)
+                    except Exception as exc:
+                        with lock:
+                            errors.append(repr(exc))
+            finally:
+                done()
+
+        workers = [threading.Thread(target=worker)
+                   for _ in range(N_WORKERS)]
+        for w in workers:
+            w.start()
+        t0 = time.perf_counter()
+        for s, payload in zip(sched, payloads):
+            delay = t0 + s - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            q.put((t0 + s, payload))
+            depths.append(q.qsize())
+        for _ in workers:
+            q.put(None)
+        for w in workers:
+            w.join()
+        span = max(time.perf_counter() - t0, 1e-9)
+        achieved = len(lats) / span
+        p50, p99 = _percentiles(lats or [0.0])
+        return {
+            "offered_per_s": rate,
+            "n": len(payloads),
+            "achieved_per_s": round(achieved, 1),
+            "achieved_ratio": round(achieved / rate, 3),
+            "latency_ms_p50": p50,
+            "latency_ms_p99": p99,
+            "queue_depth_max": max(depths, default=0),
+            "queue_depth_mean": round(
+                sum(depths) / max(len(depths), 1), 1),
+            "errors": len(errors),
+        }
+
+    def sustained(step):
+        return (step["achieved_ratio"] >= SUSTAIN_RATIO
+                and step["latency_ms_p99"] <= P99_CEIL_MS
+                and step["errors"] == 0)
+
+    def sweep(submit_factory, payloads_for):
+        steps, sat = [], 0.0
+        for ri, rate in enumerate(RATES):
+            n = min(512, max(96, rate))
+            step = open_loop(rate, payloads_for(ri, n),
+                             submit_factory, seed=77 + ri)
+            # one retry per rate: an inline XLA compile of a
+            # first-seen flush bucket mid-step snowballs the open-loop
+            # backlog — that is startup weather (the shape is warm for
+            # the retry), not steady-state capacity
+            if not sustained(step):
+                step = open_loop(rate, payloads_for(ri + 100, n),
+                                 submit_factory, seed=177 + ri)
+                step["retried"] = True
+            step["sustained"] = sustained(step)
+            steps.append(step)
+            if step["sustained"]:
+                sat = float(rate)
+            else:
+                break
+        return sat, steps
+
+    # ---------------- webhook lane (no-cache: distinct bodies) --------
+    _, batcher_w, server_w = stack()
+    httpd = server_w.run(host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+
+    def conn_factory():
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        c.connect()
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+        def submit(body):
+            c.request("POST", VALIDATING_WEBHOOK_PATH, body, headers)
+            c.getresponse().read()
+
+        return submit, c.close
+
+    try:
+        # warm off the clock: a gentle round JITs the single-flush
+        # shapes, then overload rounds drive the backlog that grows
+        # flushes to the max-batch buckets the high-rate steps hit
+        for wi, (wr, wn) in enumerate(((100, 96), (800, 256),
+                                       (800, 256))):
+            open_loop(wr, [_admission_body(wi * 10_000 + i,
+                                           salt=f"wwarm{wi}-")
+                           for i in range(wn)], conn_factory, seed=wi)
+        before_w = dict(batcher_w.stats)
+        sat_webhook, webhook_steps = sweep(
+            conn_factory,
+            lambda ri, n: [_admission_body(ri * 100_000 + i, salt="wol-")
+                           for i in range(n)])
+        webhook_counters = _counter_delta(before_w, dict(batcher_w.stats))
+    finally:
+        server_w.stop()
+        batcher_w.stop()
+
+    # ---------------- stream lane (pre-tokenized columnar rows) -------
+    cache_s, batcher_s, server_s = stack()
+    ss = StreamServer(server_s, batcher_s, cache_s).start()
+    client = StreamClient(ss.port, transport=ss.transport_name)
+    cps = cache_s.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+
+    def rows_for(base, n):
+        # tokenized OFF the clock: the columnar contract is that the
+        # client ships device-ready rows and the server only splices
+        return flatten_rows_for_wire(
+            cps, [make_pod(base + i) for i in range(n)])
+
+    def stream_factory():
+        def submit(row):
+            out = client.admit_row("Pod", "default", row, timeout=60.0)
+            if "status" not in out:
+                raise RuntimeError(f"bad stream response: {out}")
+
+        return submit, lambda: None
+
+    try:
+        for wi, (wr, wn) in enumerate(((100, 96), (800, 256),
+                                       (800, 256))):
+            open_loop(wr, rows_for(900_000 + wi * 1000, wn),
+                      stream_factory, seed=wi)
+        before_s = dict(batcher_s.stats)
+        sat_stream, stream_steps = sweep(
+            stream_factory,
+            lambda ri, n: rows_for((ri + 1) * 100_000, n))
+        stream_counters = _counter_delta(before_s, dict(batcher_s.stats))
+
+        # block granularity: the zero-copy transfer format — the server
+        # pads and dispatches the client's own tokenization, so the
+        # wire/re-intern counters must NOT move (steady-state zero-copy
+        # proof); blocks are tokenized off the clock like the rows
+        blocks = [flatten_block_for_wire(
+            cps, [make_pod(700_000 + bi * 64 + i) for i in range(64)])
+            for bi in range(12)]
+        blk_before = dict(batcher_s.stats)
+        blk_rows = 0
+        t0 = time.perf_counter()
+        for blk in blocks:
+            blk_rows += len(client.admit_block("Pod", "default",
+                                               blk)["rows"])
+        blk_s = max(time.perf_counter() - t0, 1e-9)
+        blk_delta = _counter_delta(blk_before, dict(batcher_s.stats))
+        block_mode = {
+            "blocks": len(blocks), "rows": blk_rows,
+            "rows_per_s": round(blk_rows / blk_s),
+            "reintern_rows": blk_delta.get("stream_reintern_rows", 0),
+            "row_rebuilds": blk_delta.get("stream_wire_rows", 0),
+            "zero_copy_ok": (blk_delta.get("stream_reintern_rows", 0)
+                             == blk_delta.get("stream_wire_rows", 0)
+                             == 0),
+            "counters": blk_delta,
+        }
+
+        # verdict parity: the same pods through the in-process webhook
+        # path and as columnar rows must land the same allow/deny
+        reviews = [json.loads(_admission_body(i, salt="par-"))
+                   for i in range(48)]
+        wh = [server_s.handle(VALIDATING_WEBHOOK_PATH,
+                              r)["response"]["allowed"] for r in reviews]
+        st = [client.admit_row("Pod", "default", row)["allowed"]
+              for row in flatten_rows_for_wire(
+                  cps, [r["request"]["object"] for r in reviews])]
+        if wh != st:
+            bad = [i for i, (a, b) in enumerate(zip(wh, st)) if a != b]
+            raise AssertionError(
+                f"stream/webhook verdict parity violated at {bad[:8]}")
+    finally:
+        client.close()
+        ss.stop()
+        batcher_s.stop()
+
+    return {
+        "policies": len(pols),
+        "workers": N_WORKERS,
+        "transport": ss.transport_name,
+        "sustain_ratio": SUSTAIN_RATIO,
+        "p99_ceiling_ms": P99_CEIL_MS,
+        "verdict_parity": {"n": 48, "ok": True,
+                           "denied": sum(1 for a in wh if not a)},
+        "webhook_lane": {"saturation_per_s": sat_webhook,
+                         "steps": webhook_steps,
+                         "counters": webhook_counters},
+        "stream_lane": {"saturation_per_s": sat_stream,
+                        "steps": stream_steps,
+                        "counters": stream_counters},
+        "block_mode": block_mode,
+        "stream_vs_webhook": round(
+            sat_stream / max(sat_webhook, 1e-9), 2),
+        "target": ">= 2x webhook no-cache saturation, p99 well inside "
+                  "the 10s deadline",
+        "met": sat_stream >= 2 * sat_webhook > 0,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1580,7 +1895,8 @@ def main() -> None:
                     ("4_mutate_50k", bench_config4),
                     ("5_scan_1M", bench_config5),
                     ("6_policy_update_storm", bench_config6),
-                    ("7_host_heavy_mix", bench_config7)):
+                    ("7_host_heavy_mix", bench_config7),
+                    ("9_streaming_open_loop", bench_config9)):
         if only and name.split("_")[0] not in only:
             continue
         try:

@@ -34,6 +34,9 @@ JAX_PLATFORMS=cpu python deploy/host_parity_smoke.py || rc=1
 echo "== tracing smoke (verdict parity on/off, stage coverage, /metrics parse)"
 JAX_PLATFORMS=cpu python deploy/trace_smoke.py || rc=1
 
+echo "== streaming smoke (webhook/stream parity, KTPU_STREAM=0 parity, donation)"
+JAX_PLATFORMS=cpu python deploy/stream_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

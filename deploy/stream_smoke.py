@@ -1,0 +1,218 @@
+"""Streaming-plane smoke for CI (deploy/ci_lint.sh).
+
+Brings up the webhook plane and the streaming plane on one batcher and
+fails if any of these gates break:
+
+1. **Webhook-vs-stream parity** — the same admissions produce the same
+   allow/deny AND the same denial message through the HTTP webhook,
+   through stream JSON frames, and (verdicts) through columnar ROW and
+   BLOCK frames.
+2. **Continuous-vs-window parity** — the burst rerun under
+   ``KTPU_STREAM=0`` (window semantics, no late-join, no dict
+   headroom) yields identical verdicts.
+3. **Donation-did-not-corrupt** — a donated device dispatch returns
+   verdicts identical to the undonated call and leaves the host-side
+   packed blob bit-identical.
+
+Fast by construction: one policy, a few dozen admissions, CPU backend.
+Exit 0 = OK, 1 = any gate failed.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-latest"},
+    "spec": {"validationFailureAction": "enforce", "rules": [{
+        "name": "no-latest-tag",
+        "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "latest tag not allowed",
+                     "pattern": {"spec": {"containers": [
+                         {"image": "!*:latest"}]}}},
+    }]},
+}
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 5 == 0
+                                               else f"nginx:1.{i}")}]}}
+
+
+def _review(resource, uid):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+
+
+def _stack(continuous=True):
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime.batch import AdmissionBatcher
+    from kyverno_tpu.runtime.client import FakeCluster
+    from kyverno_tpu.runtime.policycache import PolicyCache
+    from kyverno_tpu.runtime.webhook import WebhookServer
+
+    cache = PolicyCache()
+    cache.add(load_policy(POLICY))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               dispatch_cost_init_s=0.0,
+                               oracle_cost_init_s=1.0,
+                               cold_flush_fallback=False,
+                               result_cache_ttl_s=0.0,
+                               continuous=continuous)
+    server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                           admission_batcher=batcher)
+    return cache, batcher, server
+
+
+def gate_parity(n=32) -> list[str]:
+    """Webhook vs stream JSON vs columnar ROW vs BLOCK."""
+    from kyverno_tpu.runtime.policycache import PolicyType
+    from kyverno_tpu.runtime.stream_server import (StreamClient,
+                                                   StreamServer,
+                                                   flatten_block_for_wire,
+                                                   flatten_rows_for_wire)
+    from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH
+
+    failures = []
+    cache, batcher, server = _stack()
+    ss = StreamServer(server, batcher, cache).start()
+    cl = StreamClient(ss.port, transport=ss.transport_name)
+    try:
+        pods = [_pod(i) for i in range(n)]
+        webhook = [server.handle(VALIDATING_WEBHOOK_PATH,
+                                 _review(p, f"w{i}"))["response"]
+                   for i, p in enumerate(pods)]
+        streamed = [cl.admit_json(_review(p, f"w{i}"))["response"]
+                    for i, p in enumerate(pods)]
+        for i, (a, b) in enumerate(zip(webhook, streamed)):
+            if a != b:
+                failures.append(f"json parity: pod {i}: {a} != {b}")
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        rows = flatten_rows_for_wire(cps, pods)
+        for i, row in enumerate(rows):
+            out = cl.admit_row("Pod", "default", row)
+            if out["allowed"] != webhook[i]["allowed"]:
+                failures.append(f"row parity: pod {i}: "
+                                f"{out['allowed']} != "
+                                f"{webhook[i]['allowed']}")
+        block = flatten_block_for_wire(cps, pods)
+        out = cl.admit_block("Pod", "default", block)
+        if len(out["rows"]) != n:
+            failures.append(f"block row count {len(out['rows'])} != {n}")
+        for i, r in enumerate(out["rows"]):
+            if r["allowed"] != webhook[i]["allowed"]:
+                failures.append(f"block parity: pod {i}: "
+                                f"{r['allowed']} != "
+                                f"{webhook[i]['allowed']}")
+        # denial messages: webhook and stream JSON must agree verbatim
+        for i, (a, b) in enumerate(zip(webhook, streamed)):
+            ma = (a.get("status") or {}).get("message", "")
+            mb = (b.get("status") or {}).get("message", "")
+            if ma != mb:
+                failures.append(f"message parity: pod {i}: "
+                                f"{ma!r} != {mb!r}")
+    finally:
+        cl.close()
+        ss.stop()
+        batcher.stop()
+    return failures
+
+
+def gate_window_parity(n=32) -> list[str]:
+    """The same burst under KTPU_STREAM=0 (window semantics) and with
+    continuous batching must produce identical verdict rows."""
+    import concurrent.futures
+
+    from kyverno_tpu.runtime.policycache import PolicyType
+
+    def burst(env):
+        os.environ.update(env)
+        try:
+            _, batcher, _ = _stack(continuous=True)
+            try:
+                with concurrent.futures.ThreadPoolExecutor(16) as pool:
+                    # warm round first (discarded): pays the inline XLA
+                    # compile of the flush shapes so the compared round
+                    # can't hit a cold-stack screen timeout
+                    warm = [pool.submit(
+                        batcher.screen, PolicyType.VALIDATE_ENFORCE,
+                        "Pod", "default", _pod(1000 + i))
+                        for i in range(n)]
+                    for f in warm:
+                        f.result()
+                    futs = [pool.submit(
+                        batcher.screen, PolicyType.VALIDATE_ENFORCE,
+                        "Pod", "default", _pod(i)) for i in range(n)]
+                    return [f.result() for f in futs]
+            finally:
+                batcher.stop()
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+    cont = burst({})
+    window = burst({"KTPU_STREAM": "0"})
+    failures = []
+    for i, (a, b) in enumerate(zip(cont, window)):
+        if a != b:
+            failures.append(f"window parity: pod {i}: {a} != {b}")
+    return failures
+
+
+def gate_donation(n=16) -> list[str]:
+    """Donated dispatch: verdict parity with the undonated call, and
+    the host-side packed blob survives untouched."""
+    import numpy as np
+
+    from kyverno_tpu.models.engine import DONATION_STATS
+    from kyverno_tpu.runtime.policycache import PolicyType
+
+    failures = []
+    cache, batcher, _ = _stack()
+    try:
+        cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+        block = cps.flatten_packed([_pod(i) for i in range(n)])
+        blob, _ = block.packed_blob()
+        snapshot = np.asarray(blob).copy()
+        ref = np.asarray(cps.evaluate_device(block))
+        before = DONATION_STATS["dispatches"]
+        got = np.asarray(cps.evaluate_device_async(block,
+                                                   donate=True).get())
+        if DONATION_STATS["dispatches"] != before + 1:
+            failures.append("donated dispatch did not run")
+        if not np.array_equal(ref, got):
+            failures.append("donation changed verdicts")
+        after_blob, _ = block.packed_blob()
+        if not np.array_equal(np.asarray(after_blob), snapshot):
+            failures.append("donation corrupted the host-side blob")
+    finally:
+        batcher.stop()
+    return failures
+
+
+def main() -> int:
+    failures = []
+    failures += gate_parity()
+    failures += gate_window_parity()
+    failures += gate_donation()
+    if failures:
+        print("stream_smoke: FAILED")
+        for f in failures[:20]:
+            print("  -", f)
+        return 1
+    print("stream_smoke: OK (webhook/stream parity, KTPU_STREAM=0 "
+          "parity, donation integrity)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,7 +8,9 @@ batch on device and routes host-lane rules/resources through the CPU oracle
 
 from __future__ import annotations
 
+import os
 import time
+import warnings
 from dataclasses import dataclass
 from enum import IntEnum
 
@@ -45,6 +47,20 @@ _STATUS_TO_VERDICT = {
     RuleStatus.ERROR: Verdict.ERROR,
     RuleStatus.SKIP: Verdict.SKIP,
 }
+
+
+def donation_enabled() -> bool:
+    """KTPU_DONATE=0 kill switch for input-buffer donation on the
+    stable-shape device call — dynamic, like every KTPU_* lane flag."""
+    return os.environ.get("KTPU_DONATE", "1") != "0"
+
+
+# process-wide donation accounting (read by deploy/stream_smoke.py and
+# the open-loop bench): dispatches that took the donated kernel, and how
+# many of those actually had their device input buffer consumed (a
+# backend that can't alias — e.g. some CPU paths — leaves it alive; the
+# semantics are identical either way).
+DONATION_STATS = {"dispatches": 0, "donated_buffers": 0}
 
 
 @dataclass
@@ -108,6 +124,7 @@ class CompiledPolicySet:
             self.tensors: PolicyTensors = compile_tensors(rule_irs)
         self._eval_fn = None
         self._blob_eval_fn = None
+        self._blob_eval_fn_donated = None
         import threading
 
         self._eval_fn_lock = threading.Lock()
@@ -137,6 +154,26 @@ class CompiledPolicySet:
 
                     self._blob_eval_fn = build_eval_fn_blob(self.tensors)
         return self._blob_eval_fn
+
+    @property
+    def blob_eval_fn_donated(self):
+        """Donating twin of :attr:`blob_eval_fn` (donate_argnums on the
+        blob): the steady-state streaming dispatch hands its device copy
+        of the transfer buffer to XLA for reuse instead of paying a fresh
+        workspace copy per batch. Backends that can't alias the buffer
+        just ignore the donation (same verdicts, one warning suppressed
+        below)."""
+        if self._blob_eval_fn_donated is None:
+            with self._eval_fn_lock:
+                if self._blob_eval_fn_donated is None:
+                    from ..ops.eval import build_eval_fn_blob
+
+                    warnings.filterwarnings(
+                        "ignore", message="Some donated buffers were not "
+                        "usable", category=UserWarning)
+                    self._blob_eval_fn_donated = build_eval_fn_blob(
+                        self.tensors, donate=True)
+        return self._blob_eval_fn_donated
 
     def flatten(self, resources: list[dict]) -> FlatBatch:
         from .native_flatten import flatten_batch_fast
@@ -169,7 +206,7 @@ class CompiledPolicySet:
             verdicts = verdicts[:, :live]   # drop inert rule-bucket padding
         return verdicts
 
-    def evaluate_device_async(self, batch) -> "AsyncVerdicts":
+    def evaluate_device_async(self, batch, donate: bool = False) -> "AsyncVerdicts":
         """Dispatch the device eval WITHOUT blocking on the result.
 
         JAX dispatch is asynchronous: the jitted call returns a
@@ -177,8 +214,26 @@ class CompiledPolicySet:
         something materializes it. The returned handle's :meth:`get` is
         that materialization point — callers (AdmissionBatcher._flush,
         evaluate_pipelined) flatten the NEXT window between dispatch and
-        get, which is where ``overlap_s_saved`` comes from."""
+        get, which is where ``overlap_s_saved`` comes from.
+
+        ``donate=True`` (gated by KTPU_DONATE) routes through the
+        donating kernel: the blob is device_put explicitly and that
+        device copy is donated to the call, so a warm stable-shape
+        dispatch never pays a second device-side copy. The host numpy
+        blob is untouched either way — donation consumes the *device*
+        buffer only (stream_smoke's corruption check re-reads the host
+        blob after dispatch)."""
         blob, shp = batch.packed_blob()
+        if donate and donation_enabled():
+            import jax
+
+            jblob = jax.device_put(blob)
+            out = self.blob_eval_fn_donated(jblob, *shp)
+            DONATION_STATS["dispatches"] += 1
+            deleted = getattr(jblob, "is_deleted", None)
+            if callable(deleted) and deleted():
+                DONATION_STATS["donated_buffers"] += 1
+            return AsyncVerdicts(out, n_live=self.tensors.n_rules_live)
         return AsyncVerdicts(self.blob_eval_fn(blob, *shp),
                              n_live=self.tensors.n_rules_live)
 

@@ -27,6 +27,7 @@ dedup that makes the string path cheap on device.
 from __future__ import annotations
 
 import os
+import struct
 import time
 from dataclasses import dataclass
 
@@ -696,6 +697,173 @@ def merge_packed(chunks: list[PackedBatch]) -> PackedBatch:
         np.zeros((1, 5), dtype=np.uint32)
     return PackedBatch(n=B, e=E, cells=cells, bmeta=bmeta,
                        str_bytes=str_bytes, dictv=dictv)
+
+
+# ---------------------------------------------------------------- wire codec
+#
+# Columnar wire format for the streaming admission plane
+# (runtime/stream_server.py): clients ship pre-tokenized rows/blocks in
+# the packed transfer layout so the server splices them device-ready
+# without re-parsing JSON or re-walking the resource. All integers are
+# little-endian; arrays travel as raw C-contiguous buffers in the same
+# dtypes the device kernels consume.
+
+_ROW_HDR = struct.Struct("<IIII")      # P, e_row, v, bmeta
+_BLOCK_HDR = struct.Struct("<IIII")    # B, P, E, V
+
+
+def encode_packed_row(row: PackedRow) -> bytes:
+    """Serialize one PackedRow for the stream wire. Inverse of
+    :func:`decode_packed_row`; round-trips bit-exactly."""
+    p, e = (int(row.cells.shape[0]), int(row.cells.shape[1]))
+    v = int(row.dictv.shape[0])
+    return b"".join((
+        _ROW_HDR.pack(p, e, v, int(row.bmeta) & 0xFFFFFFFF),
+        np.ascontiguousarray(row.cells, dtype="<u4").tobytes(),
+        np.ascontiguousarray(row.str_bytes, dtype=np.uint8).tobytes(),
+        np.ascontiguousarray(row.dictv, dtype="<u4").tobytes(),
+    ))
+
+
+def decode_packed_row(buf, offset: int = 0) -> tuple[PackedRow, int]:
+    """Deserialize one PackedRow; returns ``(row, next_offset)``. The
+    arrays view the input buffer (zero-copy, read-only) — every consumer
+    (splice, graft) only reads them."""
+    p, e, v, bmeta = _ROW_HDR.unpack_from(buf, offset)
+    o = offset + _ROW_HDR.size
+    cells = np.frombuffer(buf, "<u4", p * e * 2, o).reshape(p, e, 2)
+    o += p * e * 2 * 4
+    str_bytes = np.frombuffer(buf, np.uint8, v * STR_LEN, o).reshape(
+        v, STR_LEN)
+    o += v * STR_LEN
+    dictv = np.frombuffer(buf, "<u4", v * 5, o).reshape(v, 5)
+    o += v * 5 * 4
+    return PackedRow(cells=cells, bmeta=int(bmeta), str_bytes=str_bytes,
+                     dictv=dictv), o
+
+
+def encode_packed_block(batch: PackedBatch) -> bytes:
+    """Serialize a whole pre-spliced PackedBatch (the zero-re-intern wire
+    granularity: the server pads and dispatches it without touching the
+    string table)."""
+    B, P, E = (int(batch.cells.shape[0]), int(batch.cells.shape[1]),
+               int(batch.cells.shape[2]))
+    V = int(batch.dictv.shape[0])
+    return b"".join((
+        _BLOCK_HDR.pack(B, P, E, V),
+        np.ascontiguousarray(batch.cells, dtype="<u4").tobytes(),
+        np.ascontiguousarray(batch.bmeta, dtype="<u4").tobytes(),
+        np.ascontiguousarray(batch.str_bytes, dtype=np.uint8).tobytes(),
+        np.ascontiguousarray(batch.dictv, dtype="<u4").tobytes(),
+    ))
+
+
+def decode_packed_block(buf, offset: int = 0) -> tuple[PackedBatch, int]:
+    """Inverse of :func:`encode_packed_block`; zero-copy read-only views."""
+    B, P, E, V = _BLOCK_HDR.unpack_from(buf, offset)
+    o = offset + _BLOCK_HDR.size
+    cells = np.frombuffer(buf, "<u4", B * P * E * 2, o).reshape(B, P, E, 2)
+    o += B * P * E * 2 * 4
+    bmeta = np.frombuffer(buf, "<u4", B, o)
+    o += B * 4
+    str_bytes = np.frombuffer(buf, np.uint8, V * STR_LEN, o).reshape(
+        V, STR_LEN)
+    o += V * STR_LEN
+    dictv = np.frombuffer(buf, "<u4", V * 5, o).reshape(V, 5)
+    o += V * 5 * 4
+    return PackedBatch(n=B, e=E, cells=cells, bmeta=bmeta,
+                       str_bytes=str_bytes, dictv=dictv), o
+
+
+def grow_dict_headroom(batch: PackedBatch,
+                       min_free: int = 1) -> PackedBatch:
+    """Pad the string table to the next power of two that leaves at
+    least ``min_free`` unused rows past the current table size — the
+    headroom continuous batching needs so a late-joining row whose
+    strings aren't all interned yet can still graft. Zero rows are the
+    natural dead encoding (same fill pad_to_buckets_packed uses), so
+    the extra slots are invisible to the kernels."""
+    from dataclasses import replace
+
+    v = int(batch.dictv.shape[0])
+    target = _next_pow2(v + max(1, min_free))
+    if target == v:
+        return batch
+    return replace(
+        batch,
+        dictv=np.pad(batch.dictv, [(0, target - v), (0, 0)]),
+        str_bytes=np.pad(batch.str_bytes, [(0, target - v), (0, 0)]))
+
+
+def graft_packed_rows(batch: PackedBatch, rows: list[PackedRow],
+                      at: int, v_used: int) -> int:
+    """Continuous-batching late-join: write ``rows`` into the padding
+    slots of an already-padded batch, in place, starting at row ``at``.
+
+    Safe only because padded row slots are fresh zero fill (np.pad always
+    copies) and the batch is flush-private. Each row's private string
+    table re-interns into the batch dictionary with the same
+    (bytes, length) key + elementwise OR-merge as splice_packed_rows
+    (exact: value lanes are pure functions of the interned string);
+    strings the batch doesn't know yet take free dictionary rows above
+    ``v_used`` — the live table size before bucket padding.
+
+    Returns how many leading rows were grafted; stops at the first row
+    that doesn't fit (slot width, path count, or dictionary capacity) so
+    the caller re-queues the rest in arrival order. Must be called
+    before the batch's blob/flat caches materialize."""
+    cells = batch.cells
+    B, P, E = int(cells.shape[0]), int(cells.shape[1]), int(cells.shape[2])
+    V = int(batch.dictv.shape[0])
+    index = getattr(batch, "_graft_index", None)
+    if index is None:
+        index = {}
+        for i in range(v_used):
+            index[(batch.str_bytes[i].tobytes(),
+                   int(batch.dictv[i, 4] & 0x7F))] = i
+        object.__setattr__(batch, "_graft_index", index)
+    else:
+        v_used = getattr(batch, "_graft_vused", v_used)
+    grafted = 0
+    for row in rows:
+        b = at + grafted
+        if b >= B:
+            break
+        p, e_row = int(row.cells.shape[0]), int(row.cells.shape[1])
+        if p != P or e_row > E:
+            break
+        # two-phase intern: count the new strings first so a row that
+        # overflows the dictionary leaves the batch untouched
+        v = int(row.dictv.shape[0])
+        keys = [(row.str_bytes[i].tobytes(), int(row.dictv[i, 4] & 0x7F))
+                for i in range(v)]
+        fresh = [k for k in keys if k not in index]
+        # dict.fromkeys: a row may reference the same new string twice
+        fresh = list(dict.fromkeys(fresh))
+        if v_used + len(fresh) > V:
+            break
+        lut = np.zeros(v + 1, dtype=np.uint32)
+        for i, key in enumerate(keys):
+            j = index.get(key)
+            if j is None:
+                j = v_used
+                index[key] = j
+                batch.str_bytes[j] = row.str_bytes[i]
+                batch.dictv[j] = row.dictv[i]
+                v_used += 1
+            else:
+                batch.dictv[j] |= row.dictv[i]
+            lut[i + 1] = j + 1
+        cells[b, :, :e_row, 0] = lut[row.cells[..., 0]]
+        cells[b, :, :e_row, 1] = row.cells[..., 1]
+        batch.bmeta[b] = np.uint32(int(row.bmeta) & 0xFFFFFFFF)
+        grafted += 1
+    object.__setattr__(batch, "_graft_vused", v_used)
+    # any lazily-built views of the pre-graft content are now stale
+    for attr in ("_blob", "_flat", "_strings", "_packed"):
+        if getattr(batch, attr, None) is not None:
+            object.__delattr__(batch, attr)
+    return grafted
 
 
 class _Interner:

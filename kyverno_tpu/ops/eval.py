@@ -902,16 +902,24 @@ def _split_blob(blob, B: int, P: int, E: int, V: int):
     return cells, bmeta, str_bytes, dictv
 
 
-def build_eval_fn_blob(tensors: PolicyTensors):
+def build_eval_fn_blob(tensors: PolicyTensors, donate: bool = False):
     """Single-transfer variant: fn(blob, B, P, E, V) -> verdict [B, R].
-    Shapes are static jit arguments (one compile per chunk geometry)."""
+    Shapes are static jit arguments (one compile per chunk geometry).
+
+    ``donate=True`` marks the blob argument donated (donate_argnums):
+    on a warm stable-shape bucket XLA may alias the input transfer
+    buffer into the kernel's workspace instead of copying it — the
+    steady-state zero-copy leg of the streaming plane. Callers must
+    device_put the blob themselves and treat the device array as
+    consumed after the call (engine.evaluate_device_async does both)."""
     from functools import partial
 
     from ..models.flatten import unpack_batch
 
     base = build_eval_fn(tensors, jit=False)
 
-    @partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    @partial(jax.jit, static_argnums=(1, 2, 3, 4),
+             donate_argnums=(0,) if donate else ())
     def evaluate_blob(blob, B, P, E, V):
         parts = _split_blob(blob, B, P, E, V)
         return base(*unpack_batch(*parts, xp=jnp))

@@ -110,7 +110,7 @@ class BackgroundScanner:
 
     def serve_observability(self, host: str = "127.0.0.1",
                             port: int = 9464):
-        """Start the standalone /metrics //healthz //debug/traces
+        """Start the standalone /metrics /healthz /debug/traces
         listener (runtime/obs_http.ObservabilityServer) — scanner-only
         processes have no webhook port to scrape. Port 0 picks a free
         port (read it back from the returned server's ``server_port``).

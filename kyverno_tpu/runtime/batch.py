@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -50,6 +51,14 @@ ORACLE = "oracle"        # low arrival rate -> skip the device entirely
 # to answer within the API server's patience even after a device miss
 WEBHOOK_TIMEOUT_S = 10.0
 SCREEN_DEADLINE_S = WEBHOOK_TIMEOUT_S / 4
+
+
+def stream_enabled() -> bool:
+    """KTPU_STREAM=0 kill switch for continuous batching: off restores
+    the window-flush semantics bit for bit (a forming batch closes at
+    drain time; nothing joins a flush after padding). Dynamic, like
+    every KTPU_* lane flag."""
+    return os.environ.get("KTPU_STREAM", "1") != "0"
 
 
 def ttl_store(cache: dict, key, ttl_s: float, value: tuple,
@@ -104,10 +113,17 @@ class AdmissionBatcher:
                  result_cache_ttl_s: float = 1.0,
                  result_cache_max: int = 4096,
                  resolve_host_in_flush: bool = True,
-                 row_cache_max: int = 4096):
+                 row_cache_max: int = 4096,
+                 continuous: bool = False):
         self.policy_cache = policy_cache
         self.window_s = window_s
         self.max_batch = max_batch
+        # continuous batching (streaming plane): a flush that padded its
+        # batch to a pow2/PAD_FLOOR bucket has free row slots — late
+        # arrivals graft into that headroom until dispatch actually
+        # fires, instead of waiting out the next window. Effective only
+        # while the KTPU_STREAM switch is on (checked per flush).
+        self.continuous = continuous
         # a device dispatch only pays off once this many requests are
         # concurrently in flight; below that the CPU oracle beats the
         # micro-batch window + device round trip for a batch of one
@@ -616,6 +632,250 @@ class AdmissionBatcher:
             self.stats["clean" if status == CLEAN else "attention"] += 1
         return status, row
 
+    # ----------------------------------------------------- streaming lane
+
+    def _row_cache_key(self, ptype, kind: str, namespace: str, row):
+        """Result-cache key for a pre-tokenized wire row: blake2b over
+        the packed arrays stands in for the JSON digest of _cache_key
+        (same generation scoping). Wire rows carry no request-identity
+        env — the stream lane serves resource-pure policy verdicts, so
+        the key is the row bytes alone."""
+        try:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            h.update(np.ascontiguousarray(row.cells).tobytes())
+            h.update(int(row.bmeta).to_bytes(4, "little"))
+            h.update(np.ascontiguousarray(row.str_bytes).tobytes())
+            h.update(np.ascontiguousarray(row.dictv).tobytes())
+            generation = getattr(self.policy_cache, "generation", 0)
+            return (generation, int(ptype), kind, namespace, h.digest())
+        except Exception:
+            return None
+
+    def screen_row(self, ptype, kind: str, namespace: str, row,
+                   timeout_s: float = SCREEN_DEADLINE_S,
+                   deadline_free: bool = False):
+        """Streaming enqueue of a pre-tokenized ``PackedRow``: the wire
+        row joins the same forming batch webhook admissions ride, so the
+        two planes coalesce into one device dispatch.
+
+        Wire rows ALWAYS take the device lane — the client already paid
+        tokenization, and a row with no JSON body has no cheap oracle
+        alternative — so the burst-threshold/cost-model gates don't
+        apply. Same (status, verdict_row) contract as screen(); HOST
+        cells stay unresolved (message "") and the caller escalates
+        them."""
+        trace = tracing.current()
+        rec = tracing.recorder()
+        try:
+            cps = self.policy_cache.compiled(ptype, kind, namespace)
+        except Exception:
+            return ATTENTION, []
+        if not cps.policies:
+            return CLEAN, []
+        if int(row.cells.shape[0]) != int(cps.tensors.n_paths):
+            # client tokenized against a stale schema generation — its
+            # path axis no longer matches the compiled tensors
+            with self._lock:
+                self.stats["stream_shape_reject"] = (
+                    self.stats.get("stream_shape_reject", 0) + 1)
+            return ATTENTION, []
+        cache_key = None
+        if self.result_cache_ttl_s > 0:
+            cache_key = self._row_cache_key(ptype, kind, namespace, row)
+            if cache_key is not None:
+                hit = self._result_cache.get(cache_key)
+                if hit is not None and hit[0] > time.monotonic():
+                    with self._lock:
+                        self.stats["cache"] = self.stats.get("cache", 0) + 1
+                        self.stats["clean" if hit[1] == CLEAN
+                                   else "attention"] += 1
+                    now_pc = time.perf_counter()
+                    rec.add_span(trace, "screen_row", now_pc, now_pc,
+                                 lane="result_cache", status=hit[1])
+                    return hit[1], hit[2]
+        fut: Future = Future()
+        now = time.monotonic()
+        with self._lock:
+            if self._stopped:
+                return ATTENTION, []
+            if now < self._circuit_open_until:
+                self.stats["oracle"] += 1
+                now_pc = time.perf_counter()
+                rec.add_span(trace, "screen_row", now_pc, now_pc,
+                             lane="circuit_open", status=ATTENTION)
+                return ATTENTION, []
+            self._arrivals.append(now)
+            while (self._arrivals
+                   and now - self._arrivals[0] > self.rate_window_s):
+                self._arrivals.popleft()
+            key = (int(ptype), kind, namespace, id(cps))
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = _Bucket(cps)
+            self.stats["device"] += 1
+            self.stats["stream_rows"] = (
+                self.stats.get("stream_rows", 0) + 1)
+            fut.ktpu_trace = trace
+            bucket.items.append((row, None, fut))
+            self._lock.notify()
+            adaptive = bool(self._seen_shapes.get(cps))
+            deadline_budget = timeout_s
+            if adaptive and not deadline_free:
+                timeout_s = min(timeout_s,
+                                max(0.05, 4 * self._dispatch_cost
+                                    + self.window_s)
+                                * (1 + self._pending_flushes))
+        wait_start = time.monotonic()
+        wait_pc = time.perf_counter()
+        try:
+            try:
+                status, vrow, device_answered = fut.result(timeout=timeout_s)
+            except FuturesTimeout:
+                remaining = deadline_budget - (time.monotonic() - wait_start)
+                if not getattr(fut, "ktpu_started", False) or remaining <= 0:
+                    raise
+                status, vrow, device_answered = fut.result(timeout=remaining)
+        except Exception:
+            with self._lock:
+                self.stats["stream_timeout"] = (
+                    self.stats.get("stream_timeout", 0) + 1)
+            rec.add_span(trace, "coalesce_wait", wait_pc,
+                         time.perf_counter(), lane="timeout",
+                         status=ATTENTION)
+            return ATTENTION, []
+        rec.add_span(trace, "coalesce_wait", wait_pc, time.perf_counter(),
+                     lane="device" if device_answered else "fallback",
+                     status=status)
+        if trace is not None:
+            flush_spans = getattr(fut, "ktpu_flush_spans", None)
+            if flush_spans:
+                trace.adopt_spans(flush_spans)
+        with self._lock:
+            if device_answered:
+                self._consecutive_timeouts = 0
+                self._timed_out_flushes.clear()
+                if cache_key is not None:
+                    self._cache_store(cache_key, status, vrow)
+            self.stats["clean" if status == CLEAN else "attention"] += 1
+        return status, vrow
+
+    def evaluate_block(self, ptype, kind: str, namespace: str, block):
+        """Whole-block evaluation for the columnar stream path: the
+        client ships a ``PackedBatch`` it tokenized itself; the server
+        pads to the XLA bucket, dispatches with buffer donation, and
+        scatters per-live-row verdicts. Zero per-row re-intern and zero
+        row rebuild by construction — the block IS the device transfer
+        format (stream_wire_rows / stream_reintern_rows counters don't
+        move on this path, which is the steady-state zero-copy proof).
+
+        HOST cells stay unresolved (no JSON bodies to re-walk): rows
+        carrying one escalate. Returns
+        ``[(CLEAN | ATTENTION, [(policy, rule, Verdict, ""), ...]), ...]``
+        one per live row, or None when the set can't serve the block."""
+        rec = tracing.recorder()
+        trace = rec.start("stream_block", rows=int(block.n))
+        if trace is not None:
+            trace.labels.update(kind=kind, namespace=namespace)
+        tok = tracing.bind(trace)
+        try:
+            try:
+                cps = self.policy_cache.compiled(ptype, kind, namespace)
+            except Exception:
+                return None
+            live_rows = [b for b in range(int(block.n))
+                         if (int(block.bmeta[b]) >> 17) & 1]
+            if not cps.policies:
+                return [(CLEAN, []) for _ in live_rows]
+            if int(block.cells.shape[1]) != int(cps.tensors.n_paths):
+                with self._lock:
+                    self.stats["stream_shape_reject"] = (
+                        self.stats.get("stream_shape_reject", 0) + 1)
+                return None
+            padded, _ = self._pad_admission(block)
+            shape_key = (padded.n, padded.e, int(padded.dictv.shape[0]))
+            with self._lock:
+                cold = shape_key not in self._seen_shapes.setdefault(
+                    cps, set())
+            d0 = time.perf_counter()
+            verdicts = cps.evaluate_device_async(padded, donate=True).get()
+            rec.add_span(trace, "xla_compile" if cold else "device_dispatch",
+                         d0, time.perf_counter(), lane="stream_block",
+                         batch=padded.n)
+            if cold:
+                with self._lock:
+                    self._seen_shapes[cps].add(shape_key)
+            s0 = time.perf_counter()
+            out = []
+            for b in live_rows:
+                vrow = []
+                clean = True
+                for ref in cps.rule_refs:
+                    v = Verdict(verdicts[b, ref.rule_index])
+                    if v is Verdict.NOT_APPLICABLE:
+                        continue
+                    vrow.append((ref.policy.name, ref.rule.name, v, ""))
+                    if v not in (Verdict.PASS, Verdict.SKIP):
+                        clean = False
+                out.append((CLEAN if clean else ATTENTION, vrow))
+            rec.add_span(trace, "scatter", s0, time.perf_counter(),
+                         rows=len(out), lane="stream_block")
+            with self._lock:
+                self.stats["stream_blocks"] = (
+                    self.stats.get("stream_blocks", 0) + 1)
+                self.stats["stream_block_rows"] = (
+                    self.stats.get("stream_block_rows", 0) + len(out))
+            return out
+        except Exception:
+            return None
+        finally:
+            tracing.unbind(tok)
+            rec.finish(trace)
+
+    def _graft_late(self, cps, batch, at, late_items, v_used):
+        """Convert late-arriving bucket items to PackedRows and graft
+        them into the padded batch's headroom slots starting at row
+        ``at``. Returns (joined_items, leftover_items) — leftovers keep
+        arrival order and go back to the bucket front."""
+        from ..models.flatten import (PackedRow, graft_packed_rows,
+                                      pipeline_enabled, split_packed_rows)
+
+        use_memo = pipeline_enabled()
+        tensors = cps.tensors
+        converted: list = []
+        n_ok = len(late_items)
+        for idx, it in enumerate(late_items):
+            payload = it[0]
+            if isinstance(payload, PackedRow):
+                converted.append((it, payload))
+                continue
+            try:
+                prow = None
+                if use_memo:
+                    d = self._row_cache.digest(payload)
+                    prow = self._row_cache.get_row(tensors.memo_space, d,
+                                                   payload, tensors)
+                if prow is None:
+                    prow = split_packed_rows(
+                        cps.flatten_packed([payload]))[0]
+                    if use_memo:
+                        self._row_cache.put_row(tensors.memo_space, d,
+                                                prow, tensors.n_paths,
+                                                tensors.dict_epoch)
+                converted.append((it, prow))
+            except Exception:
+                # an unconvertible payload ends the join here; it and
+                # everything after it wait for the next flush
+                n_ok = idx
+                break
+        grafted = graft_packed_rows(batch, [r for _, r in converted],
+                                    at, v_used)
+        joined = [it for it, _ in converted[:grafted]]
+        leftovers = ([it for it, _ in converted[grafted:]]
+                     + late_items[n_ok:])
+        return joined, leftovers
+
     # ------------------------------------------------------------- worker
 
     def _run(self) -> None:
@@ -653,7 +913,7 @@ class AdmissionBatcher:
                     self._lock.wait(timeout=remaining)
             with self._lock:
                 work = [(b.cps, b.items[:self.max_batch],
-                         k and k[-1] == "probe")
+                         k and k[-1] == "probe", k)
                         for k, b in self._buckets.items() if b.items]
                 for b in self._buckets.values():
                     del b.items[:self.max_batch]
@@ -662,15 +922,16 @@ class AdmissionBatcher:
                 # old CompiledPolicySet forever
                 self._buckets = {k: b for k, b in self._buckets.items()
                                  if b.items}
-            for cps, items, is_probe in work:
+            for cps, items, is_probe, key in work:
                 with self._lock:
                     self._pending_flushes += 1
                 self._flush_pool.submit(self._flush_tracked, cps, items,
-                                        is_probe)
+                                        is_probe, key)
 
-    def _flush_tracked(self, cps, items, is_probe: bool) -> None:
+    def _flush_tracked(self, cps, items, is_probe: bool,
+                       flush_key=None) -> None:
         try:
-            self._flush(cps, items, is_probe)
+            self._flush(cps, items, is_probe, flush_key=flush_key)
         finally:
             with self._lock:
                 self._pending_flushes -= 1
@@ -687,9 +948,59 @@ class AdmissionBatcher:
         hit rows splice with a single flatten of the misses (stored
         immediately — the split already happened). Kill-switch off means
         plain flatten, no memo traffic at all."""
-        from ..models.flatten import (pipeline_enabled, split_packed_rows,
-                                      splice_packed_rows)
+        from ..models.flatten import (PackedRow, pipeline_enabled,
+                                      split_packed_rows, splice_packed_rows)
 
+        wire_idx = [i for i, r in enumerate(resources)
+                    if isinstance(r, PackedRow)]
+        if wire_idx:
+            # columnar stream payloads ride the flush pre-tokenized: no
+            # JSON walk, no server-side flatten — straight to the splice.
+            # (They do pay the splice's re-intern; the zero-re-intern
+            # granularity is the block path, evaluate_block.)
+            rows: list = [None] * len(resources)
+            for i in wire_idx:
+                rows[i] = resources[i]
+            dict_idx = [i for i, r in enumerate(rows) if r is None]
+            n_hits = n_miss = 0
+            if dict_idx:
+                if pipeline_enabled():
+                    tensors = cps.tensors
+                    space = tensors.memo_space
+                    cache = self._row_cache
+                    digests = {i: cache.digest(resources[i])
+                               for i in dict_idx}
+                    for i in dict_idx:
+                        rows[i] = cache.get_row(space, digests[i],
+                                                resources[i], tensors)
+                        if rows[i] is not None:
+                            n_hits += 1
+                    miss_idx = [i for i in dict_idx if rows[i] is None]
+                    if miss_idx:
+                        miss_rows = split_packed_rows(cps.flatten_packed(
+                            [resources[i] for i in miss_idx]))
+                        for j, i in enumerate(miss_idx):
+                            rows[i] = miss_rows[j]
+                            cache.put_row(space, digests[i], miss_rows[j],
+                                          tensors.n_paths,
+                                          tensors.dict_epoch)
+                        n_miss = len(miss_idx)
+                else:
+                    miss_rows = split_packed_rows(cps.flatten_packed(
+                        [resources[i] for i in dict_idx]))
+                    for j, i in enumerate(dict_idx):
+                        rows[i] = miss_rows[j]
+                    n_miss = len(dict_idx)
+            with self._lock:
+                self.stats["stream_wire_rows"] = (
+                    self.stats.get("stream_wire_rows", 0) + len(wire_idx))
+                # wire rows re-intern once at the splice below; the
+                # rebuild counter must NOT move — these rows never see
+                # the flattener again
+                self.stats["stream_reintern_rows"] = (
+                    self.stats.get("stream_reintern_rows", 0)
+                    + len(wire_idx))
+            return splice_packed_rows(rows), n_hits, n_miss, None
         if not pipeline_enabled():
             return cps.flatten_packed(resources), 0, 0, None
         tensors = cps.tensors
@@ -730,7 +1041,8 @@ class AdmissionBatcher:
             self._row_cache.put_row(space, d, row, tensors.n_paths,
                                     tensors.dict_epoch)
 
-    def _flush(self, cps, items, is_probe: bool = False) -> None:
+    def _flush(self, cps, items, is_probe: bool = False,
+               flush_key=None) -> None:
         # everything — including the verdict scatter — must resolve every
         # future: an escaped exception would kill the worker thread and
         # leave all subsequent admissions blocking on their timeout
@@ -739,7 +1051,7 @@ class AdmissionBatcher:
                        probe="probe" if is_probe else "live")
         _trace_tok = tracing.bind(ft)
         try:
-            from ..models.flatten import pipeline_enabled
+            from ..models.flatten import PackedRow, pipeline_enabled
 
             for *_, fut in items:
                 # waiters whose adaptive deadline expires while this
@@ -755,9 +1067,21 @@ class AdmissionBatcher:
                          memo_hits=n_hits, memo_misses=n_miss,
                          lane=("memo" if pipeline_enabled()
                                else "kill_switch"))
+            v_used = int(raw.dictv.shape[0])
             # bucket the batch shape (pow2 + admission floor) so XLA
             # compiles once per bucket, not once per admission batch
             batch, _ = self._pad_admission(raw)
+            if (self.continuous and stream_enabled() and not is_probe
+                    and flush_key is not None):
+                # continuous batches keep string-table headroom (>= 25%
+                # of the live table) so a late arrival whose strings
+                # aren't all interned yet can still graft; the growth
+                # happens BEFORE the cold check so the headroom shape is
+                # the bucket that warms. KTPU_STREAM=0 skips this,
+                # restoring the window-mode shapes bit for bit.
+                from ..models.flatten import grow_dict_headroom
+
+                batch = grow_dict_headroom(batch, v_used // 4 + 1)
             shape_key = (batch.n, batch.e, int(batch.dictv.shape[0]))
             with self._lock:
                 cold = shape_key not in self._seen_shapes.setdefault(cps,
@@ -773,6 +1097,49 @@ class AdmissionBatcher:
                         if ft is not None:
                             fut.ktpu_flush_spans = list(ft.spans)
                         fut.set_result((ATTENTION, [], False))
+            # continuous batching (streaming plane): the padded batch has
+            # batch.n - len(items) free row slots; admissions that arrived
+            # since the window drained graft into that headroom NOW —
+            # before dispatch fires — instead of waiting out the next
+            # window. KTPU_STREAM=0 skips this block entirely, restoring
+            # the window semantics bit for bit.
+            if (self.continuous and stream_enabled() and not is_probe
+                    and not cold and flush_key is not None
+                    and batch.n > len(items)):
+                late_items: list = []
+                with self._lock:
+                    lb = self._buckets.get(flush_key)
+                    if lb is not None and lb.items:
+                        late_items = lb.items[:batch.n - len(items)]
+                        del lb.items[:len(late_items)]
+                if late_items:
+                    lj0 = time.perf_counter()
+                    joined, leftovers = self._graft_late(
+                        cps, batch, len(items), late_items, v_used)
+                    if leftovers:
+                        with self._lock:
+                            lb = self._buckets.get(flush_key)
+                            if lb is None:
+                                lb = self._buckets[flush_key] = _Bucket(cps)
+                            lb.items[:0] = leftovers
+                            self._lock.notify()
+                    if joined:
+                        for *_, fut in joined:
+                            fut.ktpu_started = True
+                        items = items + joined
+                        resources = resources + [r for r, _, _ in joined]
+                        rec.add_span(ft, "late_join", lj0,
+                                     time.perf_counter(), rows=len(joined),
+                                     lane="continuous")
+                        with self._lock:
+                            self.stats["stream_late_join_rows"] = (
+                                self.stats.get("stream_late_join_rows", 0)
+                                + len(joined))
+            # columnar wire payloads carry no JSON body the oracle could
+            # re-walk: the flush's host-lane resolution only runs over
+            # all-dict flushes (wire rows' HOST cells stay unresolved and
+            # the stream response escalates them)
+            wire_present = any(isinstance(r, PackedRow) for r in resources)
             # async dispatch (tentpole piece 3): the device starts on this
             # batch NOW; the host thread spends the flight time on work
             # that used to run after the blocking eval — splitting and
@@ -784,14 +1151,17 @@ class AdmissionBatcher:
             host_pf = None
             if pipeline_enabled() and not cold:
                 d0 = time.perf_counter()
-                handle = cps.evaluate_device_async(batch)
+                # warm stable-shape dispatch donates its device transfer
+                # buffer (KTPU_DONATE gates inside evaluate_device_async)
+                handle = cps.evaluate_device_async(batch, donate=True)
                 t_disp = time.monotonic()
                 # predictive host-lane prefetch: the flush's statically
                 # host-only cells start oracle-resolving NOW, inside the
                 # same dispatch shadow, and join at the scatter below
                 # (_resolve_flush_hosts) instead of running serially
                 # after the device verdicts land
-                if self.resolve_host_in_flush and not is_probe:
+                if (self.resolve_host_in_flush and not is_probe
+                        and not wire_present):
                     host_pf = self._start_host_prefetch(cps, items,
                                                         resources)
                 if deferred is not None:
@@ -850,7 +1220,8 @@ class AdmissionBatcher:
             messages: dict = {}
             host_resolved = 0
             live = any(not fut.done() for *_, fut in items)
-            if self.resolve_host_in_flush and live and not is_probe:
+            if (self.resolve_host_in_flush and live and not is_probe
+                    and not wire_present):
                 h0 = time.perf_counter()
                 host_resolved = self._resolve_flush_hosts(
                     cps, items, resources, verdicts, messages,
@@ -917,7 +1288,9 @@ class AdmissionBatcher:
                                        if host_pf is not None else 0),
                                    host_overlap_s=(
                                        host_pf.overlap_s()
-                                       if host_pf is not None else 0.0))
+                                       if host_pf is not None else 0.0),
+                                   batch_fill=(len(items) / batch.n
+                                               if batch.n else 0.0))
         except Exception:
             for *_, fut in items:
                 if not fut.done():
@@ -1018,7 +1391,8 @@ class AdmissionBatcher:
                           overlap_s: float = 0.0,
                           queue_depth: int = 0,
                           host_prefetch_cells: int = 0,
-                          host_overlap_s: float = 0.0) -> None:
+                          host_overlap_s: float = 0.0,
+                          batch_fill: float = 0.0) -> None:
         """Fold one flush's diagnostics into stats + the metrics registry
         (the routing split must be observable in production, not just in
         bench output)."""
@@ -1093,6 +1467,9 @@ class AdmissionBatcher:
             if overlap_s > 0:
                 metrics_mod.record_pipeline_overlap(reg, overlap_s)
             metrics_mod.record_flush_queue_depth(reg, queue_depth)
+            if batch_fill > 0:
+                metrics_mod.record_stream_gauges(reg,
+                                                 inflight_fill=batch_fill)
             if memo["hits"] or memo["misses"]:
                 metrics_mod.record_memo_survival(reg,
                                                  memo["survival_ratio"])

@@ -35,6 +35,12 @@ DEFAULT_LATENCY_BUCKETS = (
 BUCKET_OVERRIDES = {
     "kyverno_admission_flush_batch_size": (
         1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0),
+    # stream round-trips skip the webhook's HTTP/JSON tax — the ladder
+    # keeps sub-ms resolution where the columnar path actually lands
+    # while still covering queue-wait tails under saturation
+    "kyverno_stream_request_duration_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5),
 }
 
 
@@ -428,6 +434,66 @@ def record_trace(registry: MetricsRegistry, kind: str,
     labels, key = cached
     registry.inc_counter("kyverno_traces_total", labels)
     registry._observe_key("kyverno_trace_duration_seconds", key, seconds)
+
+
+def record_stream_frame(registry: MetricsRegistry, ftype: str,
+                        transport: str, seconds: float | None = None,
+                        rows: int = 1, error: bool = False) -> None:
+    """One streaming-plane admission frame (runtime/stream_server).
+    ``ftype`` is the wire frame kind (json / row / block), ``transport``
+    grpc or socket. ``seconds`` is ingest-to-response-encode, including
+    time spent waiting inside a forming batch — the open-loop latency
+    the round-10 bench sweeps."""
+    registry.inc_counter("kyverno_stream_frames_total",
+                         {"type": ftype, "transport": transport,
+                          "result": "error" if error else "ok"})
+    if rows > 1:
+        registry.inc_counter("kyverno_stream_rows_total",
+                             {"type": ftype}, float(rows))
+    else:
+        registry.inc_counter("kyverno_stream_rows_total", {"type": ftype})
+    if seconds is not None:
+        registry.observe("kyverno_stream_request_duration_seconds",
+                         {"type": ftype, "transport": transport}, seconds)
+
+
+def record_stream_gauges(registry: MetricsRegistry,
+                         open_streams: int | None = None,
+                         inflight_fill: float | None = None) -> None:
+    """Streaming-plane fill levels: ``kyverno_stream_open_streams`` is
+    the live bidirectional connection/stream count;
+    ``kyverno_stream_inflight_batch_fill`` the live-row fraction of the
+    most recent padded flush (1.0 = continuous batching packed every
+    headroom slot; chronically low means the window fires too early for
+    the offered rate)."""
+    if open_streams is not None:
+        registry.set_gauge("kyverno_stream_open_streams", {},
+                           float(open_streams))
+    if inflight_fill is not None:
+        registry.set_gauge("kyverno_stream_inflight_batch_fill", {},
+                           float(inflight_fill))
+
+
+def record_stream_zero_copy(registry: MetricsRegistry, wire_rows: int = 0,
+                            block_rows: int = 0, late_joins: int = 0,
+                            donated: int = 0) -> None:
+    """Zero-copy accounting for the columnar ingest path: rows spliced
+    straight from wire bytes (no server-side flatten), rows evaluated
+    in-place from a client block (no re-intern at all), late arrivals
+    grafted into an in-flight batch's padding, and device dispatches
+    whose input buffer was donated (steady state never copies)."""
+    if wire_rows:
+        registry.inc_counter("kyverno_stream_wire_rows_total", {},
+                             float(wire_rows))
+    if block_rows:
+        registry.inc_counter("kyverno_stream_block_rows_total", {},
+                             float(block_rows))
+    if late_joins:
+        registry.inc_counter("kyverno_stream_late_join_rows_total", {},
+                             float(late_joins))
+    if donated:
+        registry.inc_counter("kyverno_stream_donated_dispatches_total", {},
+                             float(donated))
 
 
 def record_screen_escalation(registry: MetricsRegistry, reason: str,

@@ -23,6 +23,7 @@ kube-apiserver-facing port also answers scrapes) and a standalone
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -39,7 +40,12 @@ def handle_obs_get(path: str, registry=None):
     ``None`` when ``path`` is not an observability endpoint (the caller
     falls through to its own routes / 404)."""
     parsed = urlparse(path)
-    route = parsed.path.rstrip("/") or "/"
+    # normalize: collapse duplicate slashes ("//healthz" is a classic
+    # reverse-proxy artifact) and drop trailing ones before matching.
+    # Work from the raw request target, not parsed.path — urlparse
+    # reads a leading "//" as an authority and empties the path.
+    raw = path.split("?", 1)[0].split("#", 1)[0]
+    route = re.sub(r"/{2,}", "/", raw).rstrip("/") or "/"
     if route == "/metrics":
         # settle the recorder's deferred histogram feed before exposing
         tracing.recorder().feed_metrics()
@@ -80,7 +86,7 @@ def handle_obs_get(path: str, registry=None):
 
 
 class ObservabilityServer:
-    """Standalone /metrics //healthz //debug/traces listener for
+    """Standalone /metrics /healthz /debug/traces listener for
     processes that don't run the webhook server (background scanner,
     bench drivers). Port 0 picks a free port; read it back from
     ``server_port`` after :meth:`start`."""

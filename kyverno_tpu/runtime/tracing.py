@@ -64,6 +64,8 @@ _LANE_SWITCHES = (
     ("host_prefetch", "KTPU_HOST_PREFETCH"),
     ("host_memo", "KTPU_HOST_MEMO"),
     ("host_fanout", "KTPU_HOST_FANOUT"),
+    ("stream", "KTPU_STREAM"),
+    ("donate", "KTPU_DONATE"),
 )
 
 

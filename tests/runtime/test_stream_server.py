@@ -1,0 +1,404 @@
+"""Streaming admission plane: wire codec, continuous batching, donation."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models import Verdict
+from kyverno_tpu.models.flatten import (decode_packed_block,
+                                        decode_packed_row,
+                                        encode_packed_block,
+                                        encode_packed_row,
+                                        graft_packed_rows,
+                                        grow_dict_headroom,
+                                        splice_packed_rows)
+from kyverno_tpu.runtime.batch import ATTENTION, CLEAN, AdmissionBatcher
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+from kyverno_tpu.runtime.stream_server import (StreamClient, StreamServer,
+                                               flatten_block_for_wire,
+                                               flatten_rows_for_wire)
+from kyverno_tpu.runtime.webhook import (VALIDATING_WEBHOOK_PATH,
+                                         WebhookServer)
+
+ENFORCE = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }],
+    },
+}
+
+
+def pod(image, name="p"):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "image": image}]}}
+
+
+def review(resource, uid="u"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+
+
+def make_stack(continuous=True, **kw):
+    kw.setdefault("dispatch_cost_init_s", 0.0)
+    kw.setdefault("oracle_cost_init_s", 1.0)
+    kw.setdefault("cold_flush_fallback", False)
+    kw.setdefault("result_cache_ttl_s", 0.0)
+    cache = PolicyCache()
+    cache.add(load_policy(ENFORCE))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               continuous=continuous, **kw)
+    server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                           admission_batcher=batcher)
+    cps = cache.compiled(PolicyType.VALIDATE_ENFORCE, "Pod", "default")
+    return cache, batcher, server, cps
+
+
+class TestWireCodec:
+    def test_row_round_trip(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            rows = flatten_rows_for_wire(cps, [pod("nginx:1.21"),
+                                               pod("redis:latest")])
+            for row in rows:
+                blob = encode_packed_row(row)
+                back, off = decode_packed_row(blob)
+                assert off == len(blob)
+                assert np.array_equal(back.cells, row.cells)
+                assert int(back.bmeta) == int(row.bmeta)
+                assert np.array_equal(back.str_bytes, row.str_bytes)
+                assert np.array_equal(back.dictv, row.dictv)
+        finally:
+            batcher.stop()
+
+    def test_block_round_trip_and_verdict_equivalence(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            resources = [pod("nginx:1.21"), pod("nginx:latest")]
+            block = flatten_block_for_wire(cps, resources)
+            blob = encode_packed_block(block)
+            back, off = decode_packed_block(blob)
+            assert off == len(blob)
+            ref = np.asarray(cps.evaluate_device(block))
+            got = np.asarray(cps.evaluate_device(back))
+            assert np.array_equal(ref, got)
+        finally:
+            batcher.stop()
+
+    def test_decoded_rows_splice_like_originals(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            resources = [pod("nginx:1.21"), pod("redis:6"),
+                         pod("nginx:latest")]
+            rows = flatten_rows_for_wire(cps, resources)
+            wired = [decode_packed_row(encode_packed_row(r))[0]
+                     for r in rows]
+            ref = np.asarray(cps.evaluate_device(
+                splice_packed_rows(rows)))
+            got = np.asarray(cps.evaluate_device(
+                splice_packed_rows(wired)))
+            assert np.array_equal(ref, got)
+        finally:
+            batcher.stop()
+
+
+class TestGraft:
+    def test_graft_into_headroom_matches_full_flatten(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            base = [pod("nginx:1.21"), pod("nginx:latest")]
+            late = [pod("redis:latest"), pod("redis:6")]
+            raw = cps.flatten_packed(base)
+            v_used = int(raw.dictv.shape[0])
+            padded, _ = AdmissionBatcher._pad_admission(raw)
+            padded = grow_dict_headroom(padded, v_used // 4 + 1)
+            assert padded.n >= len(base) + len(late)
+            late_rows = flatten_rows_for_wire(cps, late)
+            n = graft_packed_rows(padded, late_rows, len(base), v_used)
+            assert n == len(late)
+            ref = np.asarray(cps.evaluate_device(
+                cps.flatten_packed(base + late)))
+            got = np.asarray(cps.evaluate_device(padded))
+            assert np.array_equal(ref[:len(base) + len(late)],
+                                  got[:len(base) + len(late)])
+        finally:
+            batcher.stop()
+
+    def test_graft_rejects_overflow_without_mutation(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            base = [pod("nginx:1.21")]
+            raw = cps.flatten_packed(base)
+            padded, _ = AdmissionBatcher._pad_admission(raw)
+            # v_used == full table: a row with ANY fresh string must be
+            # rejected and must leave the batch untouched
+            v_full = int(padded.dictv.shape[0])
+            fresh = flatten_rows_for_wire(
+                cps, [pod("completely-new-image:tag-xyz",
+                          name="unseen-name")])
+            before = padded.cells.copy()
+            n = graft_packed_rows(padded, fresh, 1, v_full)
+            assert n == 0
+            assert np.array_equal(padded.cells, before)
+        finally:
+            batcher.stop()
+
+
+class TestScreenRow:
+    def test_screen_row_matches_screen(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            for image, want in ((("nginx:1.21"), CLEAN),
+                                (("nginx:latest"), ATTENTION)):
+                resource = pod(image)
+                ref_status, ref_row = batcher.screen(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                    resource)
+                row = flatten_rows_for_wire(cps, [resource])[0]
+                status, vrow = batcher.screen_row(
+                    PolicyType.VALIDATE_ENFORCE, "Pod", "default", row)
+                assert status == ref_status == want
+                assert vrow == ref_row
+        finally:
+            batcher.stop()
+
+    def test_screen_row_shape_mismatch_escalates(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            row = flatten_rows_for_wire(cps, [pod("nginx:1.21")])[0]
+            bad = row.__class__(cells=row.cells[:-1], bmeta=row.bmeta,
+                                str_bytes=row.str_bytes, dictv=row.dictv)
+            status, vrow = batcher.screen_row(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default", bad)
+            assert (status, vrow) == (ATTENTION, [])
+            assert batcher.stats.get("stream_shape_reject") == 1
+        finally:
+            batcher.stop()
+
+    def test_wire_rows_count_in_stats(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            row = flatten_rows_for_wire(cps, [pod("nginx:1.21")])[0]
+            batcher.screen_row(PolicyType.VALIDATE_ENFORCE, "Pod",
+                               "default", row)
+            assert batcher.stats.get("stream_rows", 0) >= 1
+            assert batcher.stats.get("stream_wire_rows", 0) >= 1
+        finally:
+            batcher.stop()
+
+
+class TestEvaluateBlock:
+    def test_block_verdicts_match_webhook(self):
+        _, batcher, server, cps = make_stack()
+        try:
+            resources = [pod("nginx:1.21"), pod("nginx:latest")]
+            block = flatten_block_for_wire(cps, resources)
+            results = batcher.evaluate_block(
+                PolicyType.VALIDATE_ENFORCE, "Pod", "default", block)
+            assert [st for st, _ in results] == [CLEAN, ATTENTION]
+            for resource, (_, vrow) in zip(resources, results):
+                out = server.handle(VALIDATING_WEBHOOK_PATH,
+                                    review(resource))
+                allowed = out["response"]["allowed"]
+                denies = any(v is Verdict.FAIL for _, _, v, _ in vrow)
+                assert allowed == (not denies)
+        finally:
+            batcher.stop()
+
+    def test_block_path_does_no_reintern(self):
+        _, batcher, _, cps = make_stack()
+        try:
+            block = flatten_block_for_wire(
+                cps, [pod("nginx:1.21"), pod("nginx:latest")])
+            # warm the shape, then measure the steady-state dispatch
+            batcher.evaluate_block(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", block)
+            before = (batcher.stats.get("stream_reintern_rows", 0),
+                      batcher.stats.get("flatten_cache_miss_rows", 0))
+            batcher.evaluate_block(PolicyType.VALIDATE_ENFORCE, "Pod",
+                                   "default", block)
+            after = (batcher.stats.get("stream_reintern_rows", 0),
+                     batcher.stats.get("flatten_cache_miss_rows", 0))
+            assert after == before
+            assert batcher.stats.get("stream_blocks", 0) >= 2
+        finally:
+            batcher.stop()
+
+
+class TestContinuousParity:
+    def _drive(self, continuous, env):
+        os.environ.update(env)
+        try:
+            _, batcher, _, cps = make_stack(continuous=continuous)
+            try:
+                images = [f"repo/app-{i}:latest" if i % 3 == 0
+                          else f"repo/app-{i}:v1" for i in range(24)]
+                results = [None] * len(images)
+                threads = []
+
+                def one(i):
+                    results[i] = batcher.screen(
+                        PolicyType.VALIDATE_ENFORCE, "Pod", "default",
+                        pod(images[i], name=f"p{i}"))
+
+                for i in range(len(images)):
+                    t = threading.Thread(target=one, args=(i,))
+                    t.start()
+                    threads.append(t)
+                for t in threads:
+                    t.join()
+                return results
+            finally:
+                batcher.stop()
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+    def test_stream_off_restores_window_semantics(self):
+        on = self._drive(True, {})
+        off = self._drive(True, {"KTPU_STREAM": "0"})
+        window = self._drive(False, {})
+        # all three lanes must agree on every verdict row
+        for a, b, c in zip(on, off, window):
+            assert a == b == c
+
+    def test_stream_off_never_late_joins(self):
+        _ = self._drive(True, {"KTPU_STREAM": "0"})
+        # fresh batcher in _drive — assert via a dedicated run
+        os.environ["KTPU_STREAM"] = "0"
+        try:
+            _, batcher, _, cps = make_stack(continuous=True)
+            try:
+                rows = flatten_rows_for_wire(cps, [pod("nginx:1.21")])
+                for _ in range(8):
+                    batcher.screen_row(PolicyType.VALIDATE_ENFORCE,
+                                       "Pod", "default", rows[0])
+                assert "stream_late_join_rows" not in batcher.stats
+            finally:
+                batcher.stop()
+        finally:
+            os.environ.pop("KTPU_STREAM", None)
+
+
+class TestDonation:
+    def test_donation_parity_and_host_buffer_intact(self):
+        from kyverno_tpu.models.engine import DONATION_STATS
+        _, batcher, _, cps = make_stack()
+        try:
+            block = flatten_block_for_wire(
+                cps, [pod("nginx:1.21"), pod("nginx:latest")])
+            blob, shp = block.packed_blob()
+            snapshot = np.asarray(blob).copy()
+            ref = np.asarray(cps.evaluate_device(block))
+            before = DONATION_STATS["dispatches"]
+            got = np.asarray(
+                cps.evaluate_device_async(block, donate=True).get())
+            assert DONATION_STATS["dispatches"] == before + 1
+            assert np.array_equal(ref, got)
+            # donation consumes the DEVICE copy only: the host-side blob
+            # the batch caches must be bit-identical after the call
+            assert np.array_equal(np.asarray(block.packed_blob()[0]),
+                                  snapshot)
+        finally:
+            batcher.stop()
+
+    def test_donate_kill_switch(self):
+        from kyverno_tpu.models.engine import DONATION_STATS
+        os.environ["KTPU_DONATE"] = "0"
+        try:
+            _, batcher, _, cps = make_stack()
+            try:
+                block = flatten_block_for_wire(cps, [pod("nginx:1.21")])
+                before = DONATION_STATS["dispatches"]
+                cps.evaluate_device_async(block, donate=True).get()
+                assert DONATION_STATS["dispatches"] == before
+            finally:
+                batcher.stop()
+        finally:
+            os.environ.pop("KTPU_DONATE", None)
+
+
+class TestEndToEnd:
+    @pytest.mark.parametrize("transport", ["socket", "grpc"])
+    def test_stream_matches_webhook(self, transport):
+        if transport == "grpc":
+            pytest.importorskip("grpc")
+        _, batcher, server, cps = make_stack()
+        ss = StreamServer(server, batcher, None,
+                          transport=transport).start()
+        cl = StreamClient(ss.port, transport=ss.transport_name)
+        try:
+            # JSON frames delegate to webhook.handle — exact parity
+            for image in ("nginx:1.21", "nginx:latest"):
+                direct = server.handle(VALIDATING_WEBHOOK_PATH,
+                                       review(pod(image)))
+                streamed = cl.admit_json(review(pod(image)))
+                assert streamed["response"] == direct["response"]
+            # columnar rows agree on allow/deny
+            rows = flatten_rows_for_wire(cps, [pod("nginx:1.21"),
+                                               pod("nginx:latest")])
+            assert cl.admit_row("Pod", "default", rows[0])["allowed"]
+            denied = cl.admit_row("Pod", "default", rows[1])
+            assert not denied["allowed"]
+            assert denied["verdicts"] == [
+                ["disallow-latest-tag", "validate-image-tag",
+                 int(Verdict.FAIL), ""]]
+            # block frame
+            block = flatten_block_for_wire(cps, [pod("nginx:1.21"),
+                                                 pod("nginx:latest")])
+            out = cl.admit_block("Pod", "default", block)
+            assert [r["allowed"] for r in out["rows"]] == [True, False]
+        finally:
+            cl.close()
+            ss.stop()
+            batcher.stop()
+
+    def test_socket_pipelined_burst(self):
+        _, batcher, server, cps = make_stack()
+        ss = StreamServer(server, batcher, None,
+                          transport="socket").start()
+        cl = StreamClient(ss.port, transport="socket")
+        try:
+            rows = flatten_rows_for_wire(cps, [pod("nginx:1.21"),
+                                               pod("nginx:latest")])
+            ids = [cl.submit_row("Pod", "default", rows[i % 2])
+                   for i in range(48)]
+            outs = [cl.result(i, timeout=30.0) for i in ids]
+            assert [o["allowed"] for o in outs] == [i % 2 == 0
+                                                   for i in range(48)]
+        finally:
+            cl.close()
+            ss.stop()
+            batcher.stop()
+
+    def test_unknown_frame_type_errors(self):
+        from kyverno_tpu.runtime.stream_server import (StreamAdmissionPlane,
+                                                       decode_payload,
+                                                       encode_payload,
+                                                       F_ERROR)
+        _, batcher, server, _ = make_stack()
+        try:
+            plane = StreamAdmissionPlane(server, batcher, None)
+            resp = plane.handle_payload(
+                encode_payload(0x42, 7, b""), "test")
+            ftype, req_id, body = decode_payload(resp)
+            assert ftype == F_ERROR
+            assert req_id == 7
+        finally:
+            batcher.stop()

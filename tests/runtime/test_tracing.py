@@ -364,6 +364,29 @@ class TestObsHttp:
         assert doc["status"] == "ok" and "lanes" in doc
         assert obs_http.handle_obs_get("/nope") is None
 
+    def test_route_normalization(self):
+        # duplicate and trailing slashes (reverse-proxy artifacts) must
+        # land on the same route as the canonical path
+        for path in ("//healthz", "/healthz/", "//healthz//",
+                     "///healthz"):
+            out = obs_http.handle_obs_get(path)
+            assert out is not None, path
+            status, body, _ = out
+            assert status == 200 and json.loads(body)["status"] == "ok"
+        for path in ("//metrics", "/metrics/", "//metrics//"):
+            status, body, ctype = obs_http.handle_obs_get(path)
+            assert status == 200 and ctype.startswith("text/plain")
+        out = obs_http.handle_obs_get("//debug//traces?n=1")
+        assert out is not None and out[0] == 200
+        # normalization must not invent routes
+        assert obs_http.handle_obs_get("/healthz/x") is None
+        assert obs_http.handle_obs_get("/health//z") is None
+
+    def test_lane_switches_include_stream_and_donate(self):
+        lanes = tracing.killswitch_lanes()
+        assert lanes.get("stream") == "on"
+        assert lanes.get("donate") == "on"
+
     def test_debug_traces_params(self):
         rec = tracing.recorder()
         rec.clear()

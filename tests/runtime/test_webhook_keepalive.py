@@ -1,0 +1,156 @@
+"""Webhook HTTP/1.1 keep-alive: connection reuse under concurrency.
+
+The kube-apiserver holds webhook connections open and pipelines
+admissions over them; these tests pin the transport contract — reused
+connections serve multiple POSTs, responses carry a correct
+Content-Length, and concurrent requests over distinct persistent
+connections never bleed into each other's responses."""
+
+import http.client
+import json
+import threading
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime.batch import AdmissionBatcher
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.policycache import PolicyCache
+from kyverno_tpu.runtime.webhook import (VALIDATING_WEBHOOK_PATH,
+                                         WebhookServer)
+
+ENFORCE = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "disallow-latest-tag"},
+    "spec": {
+        "validationFailureAction": "enforce",
+        "rules": [{
+            "name": "validate-image-tag",
+            "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "latest tag not allowed",
+                         "pattern": {"spec": {"containers": [
+                             {"image": "!*:latest"}]}}},
+        }],
+    },
+}
+
+
+def review_body(image, uid):
+    return json.dumps({
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": uid, "kind": {"kind": "Pod"},
+                    "namespace": "default", "operation": "CREATE",
+                    "object": {"apiVersion": "v1", "kind": "Pod",
+                               "metadata": {"name": "p",
+                                            "namespace": "default"},
+                               "spec": {"containers": [
+                                   {"name": "c", "image": image}]}}},
+    }).encode()
+
+
+def start_server():
+    cache = PolicyCache()
+    cache.add(load_policy(ENFORCE))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               dispatch_cost_init_s=0.0,
+                               oracle_cost_init_s=1.0,
+                               cold_flush_fallback=False,
+                               result_cache_ttl_s=0.0)
+    server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                           admission_batcher=batcher)
+    httpd = server.run(host="127.0.0.1", port=0)
+    port = httpd.server_address[1]
+    return server, batcher, port
+
+
+class TestKeepAlive:
+    def test_connection_reuse_many_posts(self):
+        server, batcher, port = start_server()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            for i in range(12):
+                image = "nginx:latest" if i % 2 else "nginx:1.21"
+                body = review_body(image, uid=f"reuse-{i}")
+                conn.request("POST", VALIDATING_WEBHOOK_PATH, body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                # HTTP/1.1 reuse requires an exact Content-Length
+                payload = resp.read()
+                assert int(resp.headers["Content-Length"]) == len(payload)
+                out = json.loads(payload)
+                assert out["response"]["uid"] == f"reuse-{i}"
+                assert out["response"]["allowed"] == (i % 2 == 0)
+            # one TCP connection served all twelve
+            assert conn.sock is not None
+        finally:
+            conn.close()
+            server.stop()
+            batcher.stop()
+
+    def test_concurrent_connections_no_bleed(self):
+        server, batcher, port = start_server()
+        n_conns, n_reqs = 8, 6
+        errors: list = []
+
+        def worker(ci):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30)
+            try:
+                for ri in range(n_reqs):
+                    uid = f"c{ci}-r{ri}"
+                    deny = (ci + ri) % 2 == 1
+                    image = "nginx:latest" if deny else "nginx:1.21"
+                    conn.request("POST", VALIDATING_WEBHOOK_PATH,
+                                 review_body(image, uid),
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    out = json.loads(payload)
+                    # the uid round-trips: a cross-request bleed would
+                    # hand this connection another request's response
+                    if out["response"]["uid"] != uid:
+                        errors.append((uid, out["response"]["uid"]))
+                    if out["response"]["allowed"] != (not deny):
+                        errors.append((uid, "verdict", deny,
+                                       out["response"]["allowed"]))
+                    if int(resp.headers["Content-Length"]) != len(payload):
+                        errors.append((uid, "content-length"))
+            except Exception as exc:  # surface, don't hang the join
+                errors.append((ci, repr(exc)))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_conns)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors[:5]
+        finally:
+            server.stop()
+            batcher.stop()
+
+    def test_obs_get_on_keepalive_connection(self):
+        # GET (obs surface) and POST (admissions) interleave on one
+        # persistent connection without desync
+        server, batcher, port = start_server()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            for i in range(3):
+                conn.request("POST", VALIDATING_WEBHOOK_PATH,
+                             review_body("nginx:1.21", f"mix-{i}"),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert (json.loads(resp.read())["response"]["uid"]
+                        == f"mix-{i}")
+                conn.request("GET", "//healthz")
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200
+                assert json.loads(body)["status"] == "ok"
+        finally:
+            conn.close()
+            server.stop()
+            batcher.stop()
