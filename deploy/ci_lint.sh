@@ -37,6 +37,9 @@ JAX_PLATFORMS=cpu python deploy/trace_smoke.py || rc=1
 echo "== streaming smoke (webhook/stream parity, KTPU_STREAM=0 parity, donation)"
 JAX_PLATFORMS=cpu python deploy/stream_smoke.py || rc=1
 
+echo "== observability smoke (trace continuity, top-K overflow, SLO flip, parity)"
+JAX_PLATFORMS=cpu python deploy/obs_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

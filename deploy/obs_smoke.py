@@ -1,0 +1,309 @@
+"""Observability-plane smoke for CI (deploy/ci_lint.sh).
+
+Four gates over the fleet-observability plane (PR 8):
+
+1. **Trace continuity** — one stream-client admission yields a single
+   trace id covering client enqueue, stream ingest, flush (or late
+   join), device dispatch/compile, and host resolve, over every
+   available stream transport (grpc is skipped gracefully when not
+   importable).
+2. **Top-K overflow** — with ``KTPU_ATTRIB_TOP_K`` shrunk below the
+   pair count, overflow pairs fold into the ``__other__`` series while
+   exact totals stay tracked, and ``/debug/policies`` reports both.
+3. **Watchdog flip** — an injected stall (a tiny ``KTPU_SLO_BUDGET_S``)
+   flips ``/healthz`` to ``degraded`` with burn rates >= threshold, and
+   restoring the budget clears it.
+4. **Kill-switch parity** — verdicts are bit-identical with
+   ``KTPU_TRACE=0``, ``KTPU_SLO=0``, ``KTPU_ATTRIB=0`` and
+   ``KTPU_PROPAGATE=0`` against the all-on defaults.
+
+Fast by construction: one policy, a few dozen admissions, CPU backend.
+Exit 0 = OK, 1 = any gate failed.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POLICY = {
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-latest"},
+    "spec": {"validationFailureAction": "enforce", "rules": [{
+        "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "m", "pattern": {
+            "spec": {"containers": [{"image": "!*:latest"}]}}},
+    }]},
+}
+
+# the stages one stream admission's shared trace id must cover; each
+# tuple lists alternates for the same pipeline boundary
+CONTINUITY_STAGES = (
+    ("client_enqueue",),
+    ("client_service",),
+    ("stream_ingest",),
+    ("coalesce_wait", "late_join"),
+    ("device_dispatch", "xla_compile"),
+    ("host_resolve",),
+)
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default"},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 5 == 0
+                                               else f"nginx:1.{i}")}]}}
+
+
+def _review(resource, uid):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "kind": {"kind": "Pod"},
+                        "namespace": "default", "operation": "CREATE",
+                        "object": resource}}
+
+
+def _stack(continuous=True):
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime.batch import AdmissionBatcher
+    from kyverno_tpu.runtime.client import FakeCluster
+    from kyverno_tpu.runtime.policycache import PolicyCache
+    from kyverno_tpu.runtime.webhook import WebhookServer
+
+    cache = PolicyCache()
+    cache.add(load_policy(POLICY))
+    batcher = AdmissionBatcher(cache, window_s=0.002, burst_threshold=1,
+                               dispatch_cost_init_s=0.0,
+                               oracle_cost_init_s=1.0,
+                               cold_flush_fallback=False,
+                               result_cache_ttl_s=0.0,
+                               continuous=continuous)
+    server = WebhookServer(policy_cache=cache, client=FakeCluster(),
+                           admission_batcher=batcher)
+    return cache, batcher, server
+
+
+def _transports():
+    out = ["socket"]
+    try:
+        import grpc  # noqa: F401
+
+        out.append("grpc")
+    except Exception:
+        pass
+    return out
+
+
+def gate_trace_continuity() -> list[str]:
+    """One admission per transport: a single trace id must cover the
+    client AND server halves of the pipeline."""
+    from kyverno_tpu.runtime import tracing
+    from kyverno_tpu.runtime.stream_server import StreamClient, StreamServer
+
+    failures = []
+    for transport in _transports():
+        cache, batcher, server = _stack(continuous=True)
+        ss = StreamServer(server, batcher, cache,
+                          transport=transport).start()
+        cl = StreamClient(ss.port, transport=transport)
+        rec = tracing.recorder()
+        rec.clear()
+        try:
+            tr = rec.start("client_admission", transport=transport)
+            tok = tracing.bind(tr)
+            try:
+                out = cl.admit_json(_review(_pod(1), "uid-1"), timeout=30.0)
+            finally:
+                tracing.unbind(tok)
+                rec.finish(tr)
+            if not out.get("response", {}).get("allowed"):
+                failures.append(f"continuity[{transport}]: clean pod "
+                                f"denied")
+                continue
+            tid = tr.trace_id
+            names: set = set()
+            for t in rec.traces(64):
+                if t.trace_id == tid:
+                    names |= t.stage_names()
+            for alternates in CONTINUITY_STAGES:
+                if not any(a in names for a in alternates):
+                    failures.append(
+                        f"continuity[{transport}]: trace {tid} missing "
+                        f"{'|'.join(alternates)} (has {sorted(names)})")
+        finally:
+            cl.close()
+            ss.stop()
+            batcher.stop()
+    return failures
+
+
+def gate_topk_overflow() -> list[str]:
+    """With top-K=2 and 4 distinct policies, two pairs own labelled
+    series and the rest fold into __other__ — while exact totals stay
+    tracked for all four."""
+    from kyverno_tpu.runtime import metrics as metrics_mod
+    from kyverno_tpu.runtime import obs_http
+
+    failures = []
+    st = metrics_mod.attrib_state()
+    st.reset()
+    os.environ["KTPU_ATTRIB_TOP_K"] = "2"
+    try:
+        reg = metrics_mod.registry()
+        for p in ("pa", "pb", "pc", "pd"):
+            metrics_mod.record_policy_verdicts(
+                reg, [(p, "r", "PASS", 5)], lane="flush", namespace="ns")
+        snap = metrics_mod.attribution_snapshot()
+        if snap["labelled_pairs"] != 2:
+            failures.append(f"topk: labelled_pairs {snap['labelled_pairs']}"
+                            f" != 2")
+        if snap["tracked_pairs"] != 4:
+            failures.append(f"topk: tracked_pairs {snap['tracked_pairs']}"
+                            f" != 4")
+        if snap["other_cells"] != 10:
+            failures.append(f"topk: other_cells {snap['other_cells']} != 10")
+        other = reg.counter_value(
+            "kyverno_policy_verdicts_total",
+            {"policy": "__other__", "rule": "__other__",
+             "verdict": "PASS", "lane": "flush"})
+        if other != 10:
+            failures.append(f"topk: __other__ series {other} != 10")
+        if len(snap["overflow"]) != 2:
+            failures.append(f"topk: overflow tail has "
+                            f"{len(snap['overflow'])} rows, wanted 2")
+        status, body, _ = obs_http.handle_obs_get("/debug/policies")
+        if status != 200:
+            failures.append("topk: /debug/policies not 200")
+        else:
+            payload = json.loads(body)
+            if payload.get("labelled_pairs") != 2 or \
+                    not payload.get("attrib_enabled"):
+                failures.append(f"topk: /debug/policies payload wrong: "
+                                f"{ {k: payload.get(k) for k in ('labelled_pairs', 'attrib_enabled')} }")
+    finally:
+        os.environ.pop("KTPU_ATTRIB_TOP_K", None)
+        st.reset()
+    return failures
+
+
+def gate_watchdog_flip() -> list[str]:
+    """Observations past a shrunken budget flip /healthz to degraded;
+    restoring the budget (and clearing samples) restores ok."""
+    from kyverno_tpu.runtime import obs_http
+    from kyverno_tpu.runtime.slo import watchdog
+
+    failures = []
+    w = watchdog()
+    w.clear()
+    for _ in range(16):
+        w.observe(0.005)                       # 5ms "admissions"
+    os.environ["KTPU_SLO_BUDGET_S"] = "0.001"  # 1ms budget -> burn 5x
+    try:
+        status, body, _ = obs_http.handle_obs_get("/healthz")
+        health = json.loads(body)
+        if health.get("status") != "degraded":
+            failures.append(f"watchdog: status {health.get('status')!r} "
+                            f"under injected stall, wanted degraded")
+        slo = health.get("slo", {})
+        if not slo.get("degraded"):
+            failures.append("watchdog: slo.degraded false under stall")
+        br = slo.get("burn_rate", {})
+        if not (br.get("short", 0) >= br.get("threshold", 1.0)):
+            failures.append(f"watchdog: short burn {br} below threshold")
+    finally:
+        os.environ.pop("KTPU_SLO_BUDGET_S", None)
+    w.clear()
+    status, body, _ = obs_http.handle_obs_get("/healthz")
+    health = json.loads(body)
+    if health.get("status") != "ok":
+        failures.append(f"watchdog: status {health.get('status')!r} after "
+                        f"budget restore, wanted ok")
+    # KTPU_SLO=0: observe() no-ops and /healthz reports disabled-ok
+    os.environ["KTPU_SLO"] = "0"
+    try:
+        w.observe(99.0)
+        status, body, _ = obs_http.handle_obs_get("/healthz")
+        health = json.loads(body)
+        if health.get("status") != "ok" or health["slo"].get("enabled"):
+            failures.append(f"watchdog: KTPU_SLO=0 healthz "
+                            f"{health.get('status')}/{health['slo']}")
+    finally:
+        os.environ.pop("KTPU_SLO", None)
+    w.clear()
+    return failures
+
+
+def _burst_verdicts(env: dict) -> list:
+    """Run one fixed admission burst under ``env`` overrides; returns
+    the allowed bits in submission order. (Denial *messages* are not
+    compared: which lane served a deny — device short-circuit vs host
+    oracle — legitimately varies with flush timing and changes the
+    message prose, observability lanes on or off.)"""
+    from kyverno_tpu.runtime.stream_server import StreamClient, StreamServer
+
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        cache, batcher, server = _stack(continuous=True)
+        ss = StreamServer(server, batcher, cache,
+                          transport="socket").start()
+        cl = StreamClient(ss.port, transport="socket")
+        try:
+            ids = [cl.submit_json(_review(_pod(i), f"uid-{i}"))
+                   for i in range(32)]
+            outs = [cl.result(i, timeout=30.0) for i in ids]
+            return [o["response"]["allowed"] for o in outs]
+        finally:
+            cl.close()
+            ss.stop()
+            batcher.stop()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def gate_killswitch_parity() -> list[str]:
+    """Every new lane off must reproduce the all-on verdicts bit for
+    bit — the observability plane is a pure observer."""
+    baseline = _burst_verdicts({})
+    failures = []
+    for env in ({"KTPU_TRACE": "0"}, {"KTPU_SLO": "0"},
+                {"KTPU_ATTRIB": "0"}, {"KTPU_PROPAGATE": "0"},
+                {"KTPU_TRACE": "0", "KTPU_SLO": "0", "KTPU_ATTRIB": "0",
+                 "KTPU_PROPAGATE": "0"}):
+        got = _burst_verdicts(env)
+        if got != baseline:
+            bad = sum(1 for a, b in zip(baseline, got) if a != b)
+            failures.append(f"parity: {env} diverged on {bad}/32 verdicts")
+    return failures
+
+
+def main() -> int:
+    failures = []
+    for gate in (gate_trace_continuity, gate_topk_overflow,
+                 gate_watchdog_flip, gate_killswitch_parity):
+        try:
+            failures.extend(gate())
+        except Exception as exc:
+            import traceback
+
+            traceback.print_exc()
+            failures.append(f"{gate.__name__}: {type(exc).__name__}: {exc}")
+    if failures:
+        for f in failures:
+            print(f"obs_smoke: {f}", file=sys.stderr)
+        return 1
+    transports = ", ".join(_transports())
+    print(f"obs_smoke: OK (trace continuity over {transports}; top-K "
+          f"overflow; watchdog degraded flip + restore; kill-switch "
+          f"verdict parity)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

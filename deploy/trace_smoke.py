@@ -168,6 +168,28 @@ def main() -> int:
               file=sys.stderr)
         return 1
 
+    # gate 5: /healthz carries the stream-plane and SLO state next to
+    # the lane matrix (the fleet-observability keys dashboards read)
+    status, body, ctype = obs_http.handle_obs_get("/healthz")
+    if status != 200:
+        print("trace_smoke: /healthz did not serve 200", file=sys.stderr)
+        return 1
+    health = json.loads(body)
+    for key in ("status", "lanes", "streams", "slo"):
+        if key not in health:
+            print(f"trace_smoke: /healthz missing key {key!r} "
+                  f"(has {sorted(health)})", file=sys.stderr)
+            return 1
+    for key in ("open_streams", "inflight_batch_fill", "continuous"):
+        if key not in health["streams"]:
+            print(f"trace_smoke: /healthz streams missing {key!r}",
+                  file=sys.stderr)
+            return 1
+    if health["slo"].get("enabled") and "burn_rate" not in health["slo"]:
+        print("trace_smoke: /healthz slo enabled but missing burn_rate",
+              file=sys.stderr)
+        return 1
+
     # sanity: the chrome export of the burst is valid JSON
     from kyverno_tpu.runtime import tracing
 

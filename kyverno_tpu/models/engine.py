@@ -63,6 +63,19 @@ def donation_enabled() -> bool:
 DONATION_STATS = {"dispatches": 0, "donated_buffers": 0}
 
 
+def _note_compile(seconds: float, fn: str) -> None:
+    """Feed one XLA build's wall time into the metrics registry (the
+    compile-time leg of the observability plane). Never raises — the
+    eval-fn properties sit under the build lock."""
+    try:
+        from ..runtime import metrics as metrics_mod
+
+        metrics_mod.record_xla_compile(metrics_mod.registry(), seconds,
+                                       what=fn)
+    except Exception:
+        pass
+
+
 @dataclass
 class RuleRef:
     policy: object          # ClusterPolicy
@@ -140,7 +153,9 @@ class CompiledPolicySet:
                 if self._eval_fn is None:
                     from ..ops.eval import build_eval_fn
 
+                    c0 = time.perf_counter()
                     self._eval_fn = build_eval_fn(self.tensors)
+                    _note_compile(time.perf_counter() - c0, "eval")
         return self._eval_fn
 
     @property
@@ -152,7 +167,9 @@ class CompiledPolicySet:
                 if self._blob_eval_fn is None:
                     from ..ops.eval import build_eval_fn_blob
 
+                    c0 = time.perf_counter()
                     self._blob_eval_fn = build_eval_fn_blob(self.tensors)
+                    _note_compile(time.perf_counter() - c0, "blob_eval")
         return self._blob_eval_fn
 
     @property
@@ -171,8 +188,11 @@ class CompiledPolicySet:
                     warnings.filterwarnings(
                         "ignore", message="Some donated buffers were not "
                         "usable", category=UserWarning)
+                    c0 = time.perf_counter()
                     self._blob_eval_fn_donated = build_eval_fn_blob(
                         self.tensors, donate=True)
+                    _note_compile(time.perf_counter() - c0,
+                                  "blob_eval_donated")
         return self._blob_eval_fn_donated
 
     def flatten(self, resources: list[dict]) -> FlatBatch:
@@ -285,10 +305,19 @@ class CompiledPolicySet:
                          lane="async", rows=hi - lo)
             h0 = time.perf_counter()
             with tracing.active(tr0):
-                out.append(self.resolve_host_cells(
-                    resources[lo:hi], verdicts, prefetch=pf0))
+                resolved = self.resolve_host_cells(
+                    resources[lo:hi], verdicts, prefetch=pf0)
+            out.append(resolved)
             rec.add_span(tr0, "host_resolve", h0, time.perf_counter(),
                          lane="prefetch" if pf0 is not None else "post_pass")
+            try:
+                from ..runtime import metrics as metrics_mod
+
+                metrics_mod.record_policy_verdict_matrix(
+                    metrics_mod.registry(), self.rule_refs, resolved,
+                    lane="scan")
+            except Exception:
+                pass
             rec.finish(tr0)
 
         with ThreadPoolExecutor(max_workers=1,

@@ -32,6 +32,14 @@ from ..ops.eval import V_FAIL, V_HOST, V_PASS
 
 def make_mesh(devices=None, axis: str = "data") -> Mesh:
     devices = devices if devices is not None else jax.devices()
+    try:
+        from ..runtime import metrics as metrics_mod
+
+        metrics_mod.record_mesh_devices(metrics_mod.registry(),
+                                        len(devices),
+                                        devices[0].platform)
+    except Exception:
+        pass
     return Mesh(np.array(devices), (axis,))
 
 
@@ -166,6 +174,13 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
                              cells=int(bb.size),
                              lane=("prefetch" if pf is not None
                                    else "post_pass"))
+            try:
+                from ..runtime import metrics as metrics_mod
+
+                metrics_mod.record_policy_verdict_matrix(
+                    metrics_mod.registry(), cps.rule_refs, v, lane="mesh")
+            except Exception:
+                pass
             return v, fails, passes
         finally:
             if tok is not None:

@@ -808,6 +808,7 @@ class AdmissionBatcher:
                     self._seen_shapes[cps].add(shape_key)
             s0 = time.perf_counter()
             out = []
+            attrib: dict[tuple, int] = {}
             for b in live_rows:
                 vrow = []
                 clean = True
@@ -816,11 +817,23 @@ class AdmissionBatcher:
                     if v is Verdict.NOT_APPLICABLE:
                         continue
                     vrow.append((ref.policy.name, ref.rule.name, v, ""))
+                    ak = (ref.policy.name, ref.rule.name, v.name)
+                    attrib[ak] = attrib.get(ak, 0) + 1
                     if v not in (Verdict.PASS, Verdict.SKIP):
                         clean = False
                 out.append((CLEAN if clean else ATTENTION, vrow))
             rec.add_span(trace, "scatter", s0, time.perf_counter(),
                          rows=len(out), lane="stream_block")
+            if attrib:
+                try:
+                    from . import metrics as metrics_mod
+
+                    metrics_mod.record_policy_verdicts(
+                        metrics_mod.registry(),
+                        [(p, r, v, n) for (p, r, v), n in attrib.items()],
+                        lane="block", namespace=namespace)
+                except Exception:
+                    pass
             with self._lock:
                 self.stats["stream_blocks"] = (
                     self.stats.get("stream_blocks", 0) + 1)
@@ -1235,6 +1248,11 @@ class AdmissionBatcher:
             flush_cells: dict[str, int] = {}
             flagged_rules: dict[str, int] = {}
             esc: dict[str, int] = {}
+            # per-flush attribution aggregate: (policy, rule, verdict) ->
+            # count, folded into the bounded top-K registry feed at
+            # _note_flush_stats (one recorder call per flush, never one
+            # per cell — the scatter loop stays a dict increment)
+            attrib: dict[tuple, int] = {}
             base_spans = list(ft.spans) if ft is not None else None
             for b, (_, _, fut) in enumerate(items):
                 s0 = time.perf_counter()
@@ -1248,6 +1266,8 @@ class AdmissionBatcher:
                     msg = messages.get((b, ref.rule_index), "")
                     row.append((ref.policy.name, ref.rule.name, v, msg))
                     flush_cells[v.name] = flush_cells.get(v.name, 0) + 1
+                    ak = (ref.policy.name, ref.rule.name, v.name)
+                    attrib[ak] = attrib.get(ak, 0) + 1
                     if v not in (Verdict.PASS, Verdict.SKIP):
                         clean = False
                         flagged_rules[ref.rule.name] = (
@@ -1278,6 +1298,21 @@ class AdmissionBatcher:
                     if base_spans is not None:
                         fut.ktpu_flush_spans = base_spans + [sp]
                     fut.set_result((CLEAN if clean else ATTENTION, row, True))
+            # SLO load-shed annotation (annotate-only this PR): a
+            # degraded fleet stamps the flush trace + a stat counter;
+            # verdicts and routing are untouched by construction
+            try:
+                from .slo import watchdog
+
+                ann = watchdog().annotation(max_age_s=1.0)
+                if ann is not None:
+                    if ft is not None:
+                        ft.labels.update(ann)
+                    with self._lock:
+                        self.stats["slo_degraded_flushes"] = (
+                            self.stats.get("slo_degraded_flushes", 0) + 1)
+            except Exception:
+                pass
             self._note_flush_stats(len(items), host_resolved, flush_cells,
                                    flagged_rules, esc, n_hits=n_hits,
                                    n_miss=n_miss,
@@ -1290,7 +1325,11 @@ class AdmissionBatcher:
                                        host_pf.overlap_s()
                                        if host_pf is not None else 0.0),
                                    batch_fill=(len(items) / batch.n
-                                               if batch.n else 0.0))
+                                               if batch.n else 0.0),
+                                   attrib=attrib,
+                                   namespace=(flush_key[2]
+                                              if flush_key else None),
+                                   flush_s=time.monotonic() - t0)
         except Exception:
             for *_, fut in items:
                 if not fut.done():
@@ -1392,7 +1431,10 @@ class AdmissionBatcher:
                           queue_depth: int = 0,
                           host_prefetch_cells: int = 0,
                           host_overlap_s: float = 0.0,
-                          batch_fill: float = 0.0) -> None:
+                          batch_fill: float = 0.0,
+                          attrib: dict | None = None,
+                          namespace: str | None = None,
+                          flush_s: float = 0.0) -> None:
         """Fold one flush's diagnostics into stats + the metrics registry
         (the routing split must be observable in production, not just in
         bench output)."""
@@ -1478,6 +1520,16 @@ class AdmissionBatcher:
                 memo_hits=max(0, host_memo_delta[0]),
                 memo_misses=max(0, host_memo_delta[1]),
                 overlap_s=host_overlap_s)
+            # per-policy attribution (bounded top-K + __other__) and
+            # per-policy flush-latency observations — one call per
+            # flush, fed from the scatter loop's aggregate
+            if attrib:
+                metrics_mod.record_policy_verdicts(
+                    reg, [(p, r, v, n) for (p, r, v), n in attrib.items()],
+                    lane="flush", namespace=namespace)
+                if flush_s > 0:
+                    metrics_mod.record_policy_flush_latency(
+                        reg, {p for (p, _, _) in attrib}, flush_s)
         except Exception:
             pass
 

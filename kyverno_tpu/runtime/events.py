@@ -43,9 +43,19 @@ class EventGenerator:
         return self._wq.dropped
 
     def add(self, *infos: EventInfo) -> None:
+        d0 = self._wq.dropped
         for info in infos:
             if info.name:
                 self._wq.add(info)
+        dropped = self._wq.dropped - d0
+        if dropped:
+            try:
+                from . import metrics as metrics_mod
+
+                metrics_mod.record_events(metrics_mod.registry(),
+                                          dropped=dropped)
+            except Exception:
+                pass
 
     def run(self) -> None:
         self._wq.run()
@@ -76,6 +86,12 @@ class EventGenerator:
         }
         self.client.create_resource(event)
         self.emitted += 1
+        try:
+            from . import metrics as metrics_mod
+
+            metrics_mod.record_events(metrics_mod.registry(), emitted=1)
+        except Exception:
+            pass
 
 
 def events_for_engine_response(resp, generate_success_events: bool = False) -> list[EventInfo]:

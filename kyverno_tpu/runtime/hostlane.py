@@ -364,6 +364,23 @@ class HostLaneResolver:
             trace, "host_resolve_row", r0, time.perf_counter(),
             cells=len(rule_rows), memo_hits=n_memo_hits,
             misses=len(misses), lane=lane)
+        if out:
+            try:
+                from . import metrics as metrics_mod
+
+                agg: dict[tuple, int] = {}
+                for r, (v, _msg) in out.items():
+                    ref = cps.rule_refs[r]
+                    ak = (ref.policy.name, ref.rule.name, v.name)
+                    agg[ak] = agg.get(ak, 0) + 1
+                metrics_mod.record_policy_verdicts(
+                    metrics_mod.registry(),
+                    [(p, rn, vn, n) for (p, rn, vn), n in agg.items()],
+                    lane=f"host_{lane}",
+                    namespace=(resource or {}).get("metadata",
+                                                   {}).get("namespace"))
+            except Exception:
+                pass
         return out
 
     def _oracle_misses(self, cps, resource: dict, rule_rows: list[int],
@@ -416,6 +433,14 @@ class HostLaneResolver:
                 out[r] = (_STATUS_TO_VERDICT[RuleStatus(cell[0])], cell[1])
         with self._lock:
             self.stats["pool_cells"] += len(rule_rows)
+        # the worker payload carried the admission's traceparent (webhook
+        # stamps it into the context payload); label the resolving trace
+        # so the cross-process hop stays attributable
+        tp = context.get("traceparent")
+        if tp:
+            trace = tracing.current()
+            if trace is not None:
+                trace.labels.setdefault("pool_traceparent", str(tp))
         return out
 
 

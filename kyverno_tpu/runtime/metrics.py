@@ -10,6 +10,7 @@ A periodic reset clears the registry like PromConfig's cron (metrics.go:17).
 from __future__ import annotations
 
 import bisect
+import os
 import platform
 import threading
 import time
@@ -181,6 +182,50 @@ class MetricsRegistry:
     # the exposition under its protocol-spec name; expose() predates it
     def render(self) -> str:
         return self.expose()
+
+    def gauge_value(self, name: str,
+                    labels: dict | None = None) -> float | None:
+        """Current value of one gauge series (None if never set) — how
+        the SLO watchdog and /healthz read pressure signals back out of
+        the registry without scraping themselves."""
+        with self._lock:
+            series = self._gauges.get(name)
+            if not series:
+                return None
+            return series.get(frozenset((labels or {}).items()))
+
+    def counter_value(self, name: str,
+                      labels: dict | None = None) -> float | None:
+        """Current value of one counter series (None if never touched)."""
+        with self._lock:
+            series = self._counters.get(name)
+            if not series:
+                return None
+            return series.get(frozenset((labels or {}).items()))
+
+    def counter_total(self, name: str) -> float:
+        """Sum over every label combination of one counter family."""
+        with self._lock:
+            return float(sum(self._counters.get(name, {}).values()))
+
+    def histogram_count(self, name: str,
+                        labels: dict | None = None) -> float:
+        """Observation count of one histogram family; with ``labels``,
+        summed over series whose labels are a superset of them."""
+        want = set((labels or {}).items())
+        with self._lock:
+            series = self._histograms.get(name, {})
+            return float(sum(h[0] for key, h in series.items()
+                             if want <= set(key)))
+
+    def series_count(self, name: str) -> int:
+        """Label-combination cardinality of one metric family — what the
+        attribution top-K bound is bounding."""
+        with self._lock:
+            for pop in (self._counters, self._gauges, self._histograms):
+                if name in pop:
+                    return len(pop[name])
+            return 0
 
     def histogram_quantile(self, name: str, q: float,
                            labels: dict | None = None) -> float | None:
@@ -505,3 +550,292 @@ def record_screen_escalation(registry: MetricsRegistry, reason: str,
     short-circuited."""
     registry.inc_counter("kyverno_admission_screen_escalations_total",
                          {"reason": reason}, value)
+
+
+# ------------------------------------------------- per-policy attribution
+#
+# kyverno_policy_verdicts_total{policy,rule,verdict,lane} answers "which
+# policy is burning the budget", but an unbounded label space over a
+# 10k-rule library would explode the registry (and every scrape). The
+# bound: the first KTPU_ATTRIB_TOP_K distinct (policy, rule) pairs get
+# real label values; everything past the cap folds into one
+# policy="__other__",rule="__other__" overflow series per (verdict,
+# lane). Exact per-pair totals are still kept in a plain dict (ints are
+# cheap; label cardinality is what costs), so /debug/policies reports
+# true counts for every pair including the suppressed tail.
+
+ATTRIB_OTHER = "__other__"
+
+_VERDICT_NAMES = ("NOT_APPLICABLE", "PASS", "FAIL", "SKIP", "ERROR", "HOST")
+
+
+def attrib_top_k() -> int:
+    """KTPU_ATTRIB_TOP_K: how many distinct (policy, rule) pairs get
+    their own labelled series before overflow (default 64). Dynamic so
+    tests/smokes can shrink it; shrinking does not retract already
+    admitted pairs."""
+    try:
+        return max(1, int(os.environ.get("KTPU_ATTRIB_TOP_K", "64")))
+    except ValueError:
+        return 64
+
+
+_MAX_TENANTS = 256
+
+
+class _AttributionState:
+    """Bounded attribution accounting shared by every feed point (flush
+    scatter, block eval, host-lane resolve, mesh scan chunks)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (policy, rule) -> {verdict_name: count}; membership in this
+        # dict == the pair owns labelled registry series
+        self.members: dict[tuple, dict] = {}
+        # exact totals for EVERY pair ever seen (member or overflow)
+        self.totals: dict[tuple, int] = {}
+        self.other_cells = 0
+        # namespace -> {verdict_name: count}, bounded at _MAX_TENANTS
+        self.tenants: dict[str, dict] = {}
+        # label-key cache for the registry fast path: only member pairs
+        # and the overflow series get keys, so this stays ~K*|verdicts|
+        self.key_cache: dict[tuple, frozenset] = {}
+
+    def reset(self) -> None:
+        with self.lock:
+            self.members.clear()
+            self.totals.clear()
+            self.tenants.clear()
+            self.key_cache.clear()
+            self.other_cells = 0
+
+
+_attrib = _AttributionState()
+
+
+def attrib_state() -> _AttributionState:
+    return _attrib
+
+
+def record_policy_verdicts(registry: MetricsRegistry, cells,
+                           lane: str = "flush",
+                           namespace: str | None = None) -> None:
+    """Feed one batch of attribution cells. ``cells`` is an iterable of
+    ``(policy, rule, verdict_name, count)`` aggregated by the caller per
+    flush/chunk (the hot scatter loop builds a small dict, not one call
+    per cell). No-op under KTPU_ATTRIB=0."""
+    from .tracing import attrib_enabled
+
+    if not attrib_enabled():
+        return
+    st = _attrib
+    k = attrib_top_k()
+    with st.lock:
+        for policy, rule, verdict, count in cells:
+            pair = (policy, rule)
+            st.totals[pair] = st.totals.get(pair, 0) + count
+            mem = st.members.get(pair)
+            if mem is None:
+                if len(st.members) < k:
+                    mem = st.members[pair] = {}
+                else:
+                    st.other_cells += count
+                    policy = rule = ATTRIB_OTHER
+            if mem is not None:
+                mem[verdict] = mem.get(verdict, 0) + count
+            ck = (policy, rule, verdict, lane)
+            key = st.key_cache.get(ck)
+            if key is None:
+                key = st.key_cache[ck] = frozenset({
+                    "policy": policy, "rule": rule,
+                    "verdict": verdict, "lane": lane}.items())
+            # inc under the registry's own lock; st.lock -> registry
+            # lock is the only nesting direction used anywhere
+            with registry._lock:
+                series = registry._counters.setdefault(
+                    "kyverno_policy_verdicts_total", {})
+                series[key] = series.get(key, 0.0) + count
+        if namespace is not None:
+            if namespace not in st.tenants and \
+                    len(st.tenants) >= _MAX_TENANTS:
+                namespace = ATTRIB_OTHER
+            roll = st.tenants.setdefault(namespace, {})
+            for _, _, verdict, count in cells:
+                roll[verdict] = roll.get(verdict, 0) + count
+
+
+def record_policy_verdict_matrix(registry: MetricsRegistry, rule_refs,
+                                 verdicts, lane: str,
+                                 namespace: str | None = None) -> None:
+    """Vectorized attribution feed for whole verdict matrices ([B, R]
+    numpy) — the scan/mesh paths. One (verdicts == v).sum(axis=0) pass
+    per verdict value, then the same bounded recorder as the scatter
+    loop; never one python iteration per cell."""
+    from .tracing import attrib_enabled
+
+    if not attrib_enabled() or verdicts is None or not len(rule_refs):
+        return
+    import numpy as np
+
+    v = np.asarray(verdicts)
+    if v.ndim != 2 or not v.shape[0]:
+        return
+    cells = []
+    n_rules = min(v.shape[1], len(rule_refs))
+    for code, vname in enumerate(_VERDICT_NAMES):
+        counts = np.count_nonzero(v[:, :n_rules] == code, axis=0)
+        for r in np.nonzero(counts)[0]:
+            ref = rule_refs[int(r)]
+            cells.append((ref.policy.name, ref.rule.name, vname,
+                          int(counts[r])))
+    record_policy_verdicts(registry, cells, lane=lane, namespace=namespace)
+
+
+_policy_latency_keys: dict = {}
+
+
+def record_policy_flush_latency(registry: MetricsRegistry, policies,
+                                seconds: float) -> None:
+    """Per-policy latency accounting: every policy that participated in
+    a flush observes the flush's wall time in
+    ``kyverno_policy_latency_seconds{policy}`` — so "p99 of admissions
+    involving policy X" reads off histogram_quantile. Bounded by the
+    same top-K membership as the verdict counter (non-member policies
+    observe under ``__other__``)."""
+    from .tracing import attrib_enabled
+
+    if not attrib_enabled():
+        return
+    st = _attrib
+    with st.lock:
+        member_policies = {p for p, _ in st.members}
+    for policy in policies:
+        if policy not in member_policies:
+            policy = ATTRIB_OTHER
+        key = _policy_latency_keys.get(policy)
+        if key is None:
+            key = _policy_latency_keys[policy] = frozenset(
+                {"policy": policy}.items())
+        registry._observe_key("kyverno_policy_latency_seconds", key,
+                              seconds)
+
+
+def attribution_snapshot(limit: int = 0) -> dict:
+    """/debug/policies payload: the labelled (top-K) pairs with their
+    verdict breakdowns, exact totals for the suppressed tail, and the
+    per-tenant (namespace) rollups."""
+    st = _attrib
+    with st.lock:
+        rows = [{"policy": p, "rule": r,
+                 "total": st.totals.get((p, r), 0),
+                 "verdicts": dict(v)}
+                for (p, r), v in st.members.items()]
+        rows.sort(key=lambda d: -d["total"])
+        if limit:
+            rows = rows[:limit]
+        tail = sorted(
+            ((p, r, t) for (p, r), t in st.totals.items()
+             if (p, r) not in st.members),
+            key=lambda x: -x[2])
+        return {
+            "top_k": attrib_top_k(),
+            "labelled_pairs": len(st.members),
+            "tracked_pairs": len(st.totals),
+            "other_cells": st.other_cells,
+            "policies": rows,
+            "overflow": [{"policy": p, "rule": r, "total": t}
+                         for p, r, t in tail[:32]],
+            "tenants": {ns: dict(v) for ns, v in st.tenants.items()},
+        }
+
+
+# ------------------------------------------------------------ SLO gauges
+
+
+def record_slo_gauges(registry: MetricsRegistry, p99_short: float,
+                      p99_long: float, burn_short: float,
+                      burn_long: float, queue_pressure: float,
+                      inflight_fill: float, degraded: bool,
+                      budget_s: float) -> None:
+    """The SLO watchdog's scrape surface (runtime/slo.py settles these
+    at read time, mirroring the trace recorder's deferred-settle
+    design). Burn rate is observed p99 over the deadline budget — 1.0
+    means the window's p99 sits exactly at the budget."""
+    registry.set_gauge("kyverno_slo_admission_p99_seconds",
+                       {"window": "short"}, p99_short)
+    registry.set_gauge("kyverno_slo_admission_p99_seconds",
+                       {"window": "long"}, p99_long)
+    registry.set_gauge("kyverno_slo_burn_rate", {"window": "short"},
+                       burn_short)
+    registry.set_gauge("kyverno_slo_burn_rate", {"window": "long"},
+                       burn_long)
+    registry.set_gauge("kyverno_slo_queue_pressure", {}, queue_pressure)
+    registry.set_gauge("kyverno_slo_inflight_fill", {}, inflight_fill)
+    registry.set_gauge("kyverno_slo_degraded", {},
+                       1.0 if degraded else 0.0)
+    registry.set_gauge("kyverno_slo_budget_seconds", {}, budget_s)
+
+
+# ------------------------------------- reports / events (reference ports)
+
+
+def record_report_queue_depth(registry: MetricsRegistry, queued: int,
+                              pending: int = 0) -> None:
+    """Depth of the report generator's async change-request writer queue
+    plus its unaggregated pending set (runtime/reports.py) — the fan-in
+    backlog the reference tracks via its RCR workqueue."""
+    registry.set_gauge("kyverno_report_queue_depth", {}, float(queued))
+    registry.set_gauge("kyverno_report_pending_results", {}, float(pending))
+
+
+def record_events(registry: MetricsRegistry, emitted: int = 0,
+                  dropped: int = 0) -> None:
+    """Cluster-event emission counters (runtime/events.py): events
+    written vs events the rate-limited queue dropped."""
+    if emitted:
+        registry.inc_counter("kyverno_events_emitted_total", {},
+                             float(emitted))
+    if dropped:
+        registry.inc_counter("kyverno_events_rate_limited_total", {},
+                             float(dropped))
+
+
+# ------------------------------------------------------------- profiling
+
+
+def record_xla_compile(registry: MetricsRegistry, seconds: float,
+                       what: str = "eval") -> None:
+    """One XLA executable build (models/engine.py eval-fn properties):
+    count + cumulative seconds, labelled by which kernel compiled."""
+    registry.inc_counter("kyverno_xla_compiles_total", {"fn": what})
+    registry.inc_counter("kyverno_xla_compile_seconds_total",
+                         {"fn": what}, seconds)
+
+
+def record_device_memory(registry: MetricsRegistry, stats: dict,
+                         device: str = "0") -> None:
+    """Device memory gauges from jax memory_stats() (bytes_in_use /
+    peak_bytes_in_use / bytes_limit when the backend reports them)."""
+    for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_alloc_size"):
+        if k in stats:
+            registry.set_gauge("kyverno_device_memory_bytes",
+                               {"device": device, "kind": k},
+                               float(stats[k]))
+
+
+def record_profile_capture(registry: MetricsRegistry,
+                           seconds: float) -> None:
+    """One completed /debug/profile window capture."""
+    registry.inc_counter("kyverno_profile_captures_total", {})
+    registry.inc_counter("kyverno_profile_capture_seconds_total", {},
+                         seconds)
+
+
+def record_mesh_devices(registry: MetricsRegistry, count: int,
+                        platform_name: str) -> None:
+    """Device inventory gauge stamped when a mesh is built
+    (parallel/mesh.py make_mesh) — the denominator for any per-device
+    rate an operator derives from the scan counters."""
+    registry.set_gauge("kyverno_mesh_devices",
+                       {"platform": platform_name}, float(count))

@@ -11,13 +11,23 @@ kube-apiserver-facing port also answers scrapes) and a standalone
     including the ``kyverno_stage_duration_seconds`` bucket histograms
     the trace recorder feeds, so per-stage p50/p99 are scrapeable.
 ``/healthz``
-    JSON liveness snapshot: build version, trace-recorder counters,
-    uptime.
+    JSON liveness snapshot: ``ok``/``degraded`` status (the SLO
+    watchdog's verdict), trace-recorder counters, the kill-switch lane
+    matrix, stream-plane state (open streams, inflight batch fill,
+    continuous flag), and the SLO burn-rate snapshot.
 ``/debug/traces``
     Flight-recorder dump (JSON). Query params: ``n`` (max traces,
     default 32), ``slowest=1`` (the K-slowest set instead of the
     newest), ``format=chrome`` (Chrome ``trace_event`` JSON for
     chrome://tracing / Perfetto instead of the plain schema).
+``/debug/policies``
+    Per-policy attribution snapshot: labelled top-K (policy, rule)
+    pairs with verdict breakdowns, the exact-total overflow tail, and
+    per-tenant rollups. ``n`` caps the pair rows.
+``/debug/profile``
+    On-demand device profiling: paramless GET = capture status plus a
+    device-memory snapshot; ``?seconds=N`` starts a bounded
+    jax.profiler window capture (409 while one is running).
 """
 
 from __future__ import annotations
@@ -33,6 +43,17 @@ from . import metrics as metrics_mod
 from . import tracing
 
 _started_at = time.time()
+
+
+def _stream_enabled() -> bool:
+    """Continuous-batching lane flag, without importing batch at module
+    load (obs_http must stay importable from anything)."""
+    try:
+        from .batch import stream_enabled
+
+        return stream_enabled()
+    except Exception:
+        return False
 
 
 def handle_obs_get(path: str, registry=None):
@@ -54,14 +75,55 @@ def handle_obs_get(path: str, registry=None):
     if route == "/healthz":
         rec = tracing.recorder()
         rec.feed_metrics()
+        reg = registry if registry is not None else metrics_mod.registry()
+        from .slo import watchdog
+
+        slo = watchdog().snapshot()
         body = json.dumps({
-            "status": "ok",
+            "status": "degraded" if slo.get("degraded") else "ok",
             "uptime_s": round(time.time() - _started_at, 3),
             "tracing_enabled": tracing.trace_enabled(),
             "traces": dict(rec.stats),
             "lanes": tracing.killswitch_lanes(),
+            # PR 7 stream-plane fill state, next to the lane matrix
+            "streams": {
+                "open_streams": int(reg.gauge_value(
+                    "kyverno_stream_open_streams") or 0),
+                "inflight_batch_fill": reg.gauge_value(
+                    "kyverno_stream_inflight_batch_fill") or 0.0,
+                "continuous": _stream_enabled(),
+            },
+            "slo": slo,
         }).encode()
         return 200, body, "application/json"
+    if route == "/debug/policies":
+        q = parse_qs(parsed.query)
+        try:
+            limit = max(0, int(q.get("n", ["0"])[0]))
+        except ValueError:
+            limit = 0
+        payload = metrics_mod.attribution_snapshot(limit=limit)
+        payload["attrib_enabled"] = tracing.attrib_enabled()
+        return 200, json.dumps(payload).encode(), "application/json"
+    if route == "/debug/profile":
+        from . import profiling
+
+        q = parse_qs(parsed.query)
+        svc = profiling.capture_service()
+        seconds_arg = q.get("seconds", [None])[0]
+        if seconds_arg is None:
+            payload = {"status": "idle", **svc.status(),
+                       "device_memory": profiling.device_memory_snapshot()}
+            return 200, json.dumps(payload).encode(), "application/json"
+        try:
+            seconds = float(seconds_arg)
+        except ValueError:
+            return (400, json.dumps({"error": "seconds must be a "
+                                     "number"}).encode(),
+                    "application/json")
+        out = svc.start(seconds)
+        status = 409 if out.get("status") == "busy" else 200
+        return status, json.dumps(out).encode(), "application/json"
     if route == "/debug/traces":
         q = parse_qs(parsed.query)
 

@@ -13,6 +13,9 @@ from __future__ import annotations
 
 import contextlib
 import os
+import tempfile
+import threading
+import time
 
 _server_started = False
 
@@ -49,3 +52,132 @@ def trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+# --------------------------------------------- on-demand window capture
+#
+# /debug/profile?seconds=N (runtime/obs_http.py) triggers a programmatic
+# jax.profiler window capture to a tmpdir while live traffic keeps
+# flowing — the operator never restarts a serving process to profile it.
+# Single-flight: one capture at a time; a second request while capturing
+# reports "busy" instead of corrupting the active session.
+
+MAX_CAPTURE_S = 60.0
+
+
+class ProfileCaptureService:
+    """Window-capture state machine behind /debug/profile."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._capturing = False
+        self._thread: threading.Thread | None = None
+        self.last: dict = {}             # outcome of the last capture
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"capturing": self._capturing, "last": dict(self.last)}
+
+    def start(self, seconds: float, log_dir: str | None = None) -> dict:
+        """Kick off one capture window on a daemon thread; returns
+        immediately with the capture's log dir (or busy/error)."""
+        seconds = min(max(0.05, float(seconds)), MAX_CAPTURE_S)
+        with self._lock:
+            if self._capturing:
+                return {"status": "busy", "last": dict(self.last)}
+            self._capturing = True
+        if log_dir is None:
+            log_dir = tempfile.mkdtemp(prefix="ktpu-profile-")
+        # non-daemon on purpose: interpreter shutdown joins it BEFORE
+        # finalization, so stop_trace always runs in a healthy runtime.
+        # A daemon thread here segfaults the process when exit lands
+        # mid-capture — the profiler's python hooks die inside
+        # finalization and the native session teardown crashes. Worst
+        # case this delays exit by the capture window plus flush.
+        th = threading.Thread(target=self._run, args=(seconds, log_dir),
+                              daemon=False, name="ktpu-profile-capture")
+        with self._lock:
+            self._thread = th
+        th.start()
+        return {"status": "capturing", "seconds": seconds,
+                "log_dir": log_dir}
+
+    def drain(self, timeout: float = MAX_CAPTURE_S + 30.0) -> None:
+        """Block until any in-flight capture finishes (bounded)."""
+        with self._lock:
+            th = self._thread
+        if th is not None and th.is_alive():
+            th.join(timeout)
+
+    def _run(self, seconds: float, log_dir: str) -> None:
+        t0 = time.time()
+        err = None
+        try:
+            with trace(log_dir):
+                time.sleep(seconds)
+        except Exception as e:            # profiler unavailable/failed
+            err = f"{type(e).__name__}: {e}"
+        outcome = {
+            "log_dir": log_dir,
+            "seconds": round(time.time() - t0, 3),
+            "requested_s": seconds,
+            "finished_at": time.time(),
+            "error": err,
+        }
+        with self._lock:
+            self.last = outcome
+            self._capturing = False
+        if err is None:
+            try:
+                from . import metrics as metrics_mod
+
+                metrics_mod.record_profile_capture(
+                    metrics_mod.registry(), outcome["seconds"])
+            except Exception:
+                pass
+
+
+_capture: ProfileCaptureService | None = None
+_capture_lock = threading.Lock()
+
+
+def capture_service() -> ProfileCaptureService:
+    global _capture
+    if _capture is None:
+        with _capture_lock:
+            if _capture is None:
+                _capture = ProfileCaptureService()
+    return _capture
+
+
+def device_memory_snapshot(update_metrics: bool = True) -> dict:
+    """Per-device memory accounting (bytes_in_use / peak / limit) from
+    ``jax`` ``memory_stats()``, gauge-fed into the registry. Backends
+    that don't report (CPU often returns None) yield ``{}`` per device
+    rather than failing the endpoint."""
+    out: dict = {}
+    try:
+        import jax
+
+        for i, dev in enumerate(jax.devices()):
+            stats = {}
+            try:
+                stats = dev.memory_stats() or {}
+            except Exception:
+                stats = {}
+            keep = {k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float)) and k in (
+                        "bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit", "largest_alloc_size")}
+            out[str(i)] = {"platform": dev.platform, **keep}
+            if update_metrics and keep:
+                try:
+                    from . import metrics as metrics_mod
+
+                    metrics_mod.record_device_memory(
+                        metrics_mod.registry(), keep, device=str(i))
+                except Exception:
+                    pass
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out

@@ -24,6 +24,11 @@ Payload layout (both transports, little-endian)::
 
     u8 ftype | u64 req_id | body
 
+    ftype may carry F_TRACE_BIT (0x40) on admission frames, in which
+    case body is prefixed with u16 tplen|traceparent (cross-process
+    trace context; see runtime/tracing.py). Frames without the bit
+    decode exactly as before.
+
     F_ADMIT_JSON  body = AdmissionReview JSON (utf-8)
     F_ADMIT_ROW   body = u16 klen|kind|u16 nslen|ns|encode_packed_row
     F_ADMIT_BLOCK body = u16 klen|kind|u16 nslen|ns|encode_packed_block
@@ -66,6 +71,16 @@ F_ADMIT_BLOCK = 0x03
 F_VERDICT = 0x81
 F_ERROR = 0x7F
 
+# Optional trace-context carriage: admission frames may set this bit on
+# ftype, in which case the body is prefixed with ``u16 tplen|traceparent``
+# (runtime/tracing.py W3C-style rendering). The bit is only honored when
+# the masked type is an admission frame, so F_ERROR (0x7F, which has the
+# bit set numerically) and foreign frame types decode unchanged; servers
+# that predate the bit reject flagged frames as unknown types rather than
+# mis-parsing them.
+F_TRACE_BIT = 0x40
+_TRACEABLE = (F_ADMIT_JSON, F_ADMIT_ROW, F_ADMIT_BLOCK)
+
 _PAYLOAD_HDR = struct.Struct("<BQ")
 _LEN_PREFIX = struct.Struct("<I")
 _U16 = struct.Struct("<H")
@@ -83,16 +98,42 @@ def transport_preference() -> str:
 # ------------------------------------------------------------------ codec
 
 
-def encode_payload(ftype: int, req_id: int, body: bytes) -> bytes:
+def encode_payload(ftype: int, req_id: int, body: bytes,
+                   traceparent: str | None = None) -> bytes:
+    if traceparent and ftype in _TRACEABLE:
+        tp = traceparent.encode("ascii")
+        return b"".join((_PAYLOAD_HDR.pack(ftype | F_TRACE_BIT, req_id),
+                         _U16.pack(len(tp)), tp, body))
     return _PAYLOAD_HDR.pack(ftype, req_id) + body
+
+
+def decode_payload_ex(payload: bytes) -> tuple[int, int, bytes, str | None]:
+    """(ftype, req_id, body, traceparent-or-None). Raises ValueError on a
+    short payload. A flagged frame whose trace prefix is truncated keeps
+    its raw (flagged) ftype and body — the caller's unknown-type path
+    then rejects it with the req_id intact instead of losing the frame
+    to a parse exception."""
+    if len(payload) < _PAYLOAD_HDR.size:
+        raise ValueError(f"short payload: {len(payload)} bytes")
+    ftype, req_id = _PAYLOAD_HDR.unpack_from(payload, 0)
+    off = _PAYLOAD_HDR.size
+    tp = None
+    if ftype & F_TRACE_BIT and (ftype & ~F_TRACE_BIT) in _TRACEABLE:
+        if len(payload) >= off + _U16.size:
+            (tplen,) = _U16.unpack_from(payload, off)
+            if len(payload) >= off + _U16.size + tplen:
+                ftype &= ~F_TRACE_BIT
+                off += _U16.size
+                tp = bytes(payload[off:off + tplen]).decode(
+                    "ascii", "replace")
+                off += tplen
+    return ftype, req_id, payload[off:], tp
 
 
 def decode_payload(payload: bytes) -> tuple[int, int, bytes]:
     """(ftype, req_id, body). Raises ValueError on a short payload."""
-    if len(payload) < _PAYLOAD_HDR.size:
-        raise ValueError(f"short payload: {len(payload)} bytes")
-    ftype, req_id = _PAYLOAD_HDR.unpack_from(payload, 0)
-    return ftype, req_id, payload[_PAYLOAD_HDR.size:]
+    ftype, req_id, body, _ = decode_payload_ex(payload)
+    return ftype, req_id, body
 
 
 def _encode_scoped(kind: str, namespace: str, blob: bytes) -> bytes:
@@ -114,26 +155,31 @@ def _decode_scoped(body: bytes) -> tuple[str, str, bytes, int]:
     return kind, namespace, body, off
 
 
-def encode_row_frame(req_id: int, kind: str, namespace: str, row) -> bytes:
+def encode_row_frame(req_id: int, kind: str, namespace: str, row,
+                     traceparent: str | None = None) -> bytes:
     from ..models.flatten import encode_packed_row
 
     return encode_payload(F_ADMIT_ROW, req_id,
                           _encode_scoped(kind, namespace,
-                                         encode_packed_row(row)))
+                                         encode_packed_row(row)),
+                          traceparent=traceparent)
 
 
 def encode_block_frame(req_id: int, kind: str, namespace: str,
-                       block) -> bytes:
+                       block, traceparent: str | None = None) -> bytes:
     from ..models.flatten import encode_packed_block
 
     return encode_payload(F_ADMIT_BLOCK, req_id,
                           _encode_scoped(kind, namespace,
-                                         encode_packed_block(block)))
+                                         encode_packed_block(block)),
+                          traceparent=traceparent)
 
 
-def encode_json_frame(req_id: int, review: dict) -> bytes:
+def encode_json_frame(req_id: int, review: dict,
+                      traceparent: str | None = None) -> bytes:
     return encode_payload(F_ADMIT_JSON, req_id,
-                          json.dumps(review).encode("utf-8"))
+                          json.dumps(review).encode("utf-8"),
+                          traceparent=traceparent)
 
 
 # ------------------------------------------------------- client-side prep
@@ -204,7 +250,10 @@ class StreamAdmissionPlane:
         rows = 1
         error = False
         try:
-            ftype, req_id, body = decode_payload(payload)
+            ftype, req_id, body, tp = decode_payload_ex(payload)
+            if tp:
+                tracing.adopt_remote_id(trace,
+                                        tracing.parse_traceparent(tp))
             rec.add_span(trace, "stream_ingest", t_in, time.perf_counter(),
                          bytes=len(payload), transport=transport)
             if ftype == F_ADMIT_JSON:
@@ -264,6 +313,16 @@ class StreamAdmissionPlane:
         finally:
             tracing.unbind(tok)
             rec.finish(trace)
+            if ftype_name in ("row", "block") and not error:
+                # JSON frames route through webhook._handle, which
+                # already feeds the watchdog; row/block frames are the
+                # only admissions that bypass it
+                try:
+                    from .slo import watchdog
+
+                    watchdog().observe(time.perf_counter() - t_in)
+                except Exception:
+                    pass
             try:
                 from . import metrics as metrics_mod
 
@@ -532,6 +591,9 @@ class StreamClient:
         self._lock = threading.Lock()
         self._next_id = 1
         self._waiters: dict[int, queue.Queue] = {}
+        # req_id -> (caller's trace, t_submit, t_sent): client-side span
+        # bookkeeping so result() can split queue wait from service time
+        self._traces: dict[int, tuple] = {}
         if transport == "grpc":
             import grpc
 
@@ -607,21 +669,40 @@ class StreamClient:
         if q is not None:
             q.put((ftype, bytes(body)))
 
+    def _track(self, req_id: int, t_submit: float) -> None:
+        trace = tracing.current()
+        if trace is not None:
+            with self._lock:
+                self._traces[req_id] = (trace, t_submit,
+                                        time.perf_counter())
+
     # -- public API
 
     def submit_json(self, review: dict) -> int:
         req_id, _ = self._register()
-        self._send(encode_json_frame(req_id, review))
+        t0 = time.perf_counter()
+        self._send(encode_json_frame(
+            req_id, review,
+            traceparent=tracing.make_traceparent(tracing.current())))
+        self._track(req_id, t0)
         return req_id
 
     def submit_row(self, kind: str, namespace: str, row) -> int:
         req_id, _ = self._register()
-        self._send(encode_row_frame(req_id, kind, namespace, row))
+        t0 = time.perf_counter()
+        self._send(encode_row_frame(
+            req_id, kind, namespace, row,
+            traceparent=tracing.make_traceparent(tracing.current())))
+        self._track(req_id, t0)
         return req_id
 
     def submit_block(self, kind: str, namespace: str, block) -> int:
         req_id, _ = self._register()
-        self._send(encode_block_frame(req_id, kind, namespace, block))
+        t0 = time.perf_counter()
+        self._send(encode_block_frame(
+            req_id, kind, namespace, block,
+            traceparent=tracing.make_traceparent(tracing.current())))
+        self._track(req_id, t0)
         return req_id
 
     def result(self, req_id: int, timeout: float = 30.0) -> dict:
@@ -641,6 +722,16 @@ class StreamClient:
         finally:
             with self._lock:
                 self._waiters.pop(req_id, None)
+                tracked = self._traces.pop(req_id, None)
+            if tracked is not None:
+                trace, t_submit, t_sent = tracked
+                rec = tracing.recorder()
+                rec.add_span(trace, "client_enqueue", t_submit, t_sent,
+                             req_id=str(req_id),
+                             transport=self.transport)
+                rec.add_span(trace, "client_service", t_sent,
+                             time.perf_counter(), req_id=str(req_id),
+                             transport=self.transport)
         if ftype == F_ERROR:
             raise RuntimeError(body.decode("utf-8", "replace"))
         return json.loads(body)

@@ -66,7 +66,27 @@ _LANE_SWITCHES = (
     ("host_fanout", "KTPU_HOST_FANOUT"),
     ("stream", "KTPU_STREAM"),
     ("donate", "KTPU_DONATE"),
+    ("attrib", "KTPU_ATTRIB"),
+    ("slo", "KTPU_SLO"),
+    ("propagate", "KTPU_PROPAGATE"),
 )
+
+
+def attrib_enabled() -> bool:
+    """KTPU_ATTRIB=0 kill switch for per-policy attribution metrics."""
+    return os.environ.get("KTPU_ATTRIB", "1") != "0"
+
+
+def slo_enabled() -> bool:
+    """KTPU_SLO=0 kill switch for the SLO watchdog (observation only —
+    the watchdog never changes verdicts either way)."""
+    return os.environ.get("KTPU_SLO", "1") != "0"
+
+
+def propagate_enabled() -> bool:
+    """KTPU_PROPAGATE=0 kill switch for cross-process trace-context
+    propagation (stream frames, webhook headers, oracle-pool payloads)."""
+    return os.environ.get("KTPU_PROPAGATE", "1") != "0"
 
 
 def killswitch_lanes() -> dict:
@@ -452,3 +472,77 @@ def bind(trace: Trace | None):
 
 def unbind(token) -> None:
     _current.reset(token)
+
+
+# ------------------------------------------- cross-process propagation
+#
+# W3C-traceparent-style context: ``00-<trace-id 32hex>-<span-id 16hex>-01``.
+# The 32-hex trace-id field carries the recorder's native trace id
+# (ascii, e.g. "688f3c1a-00012f") hex-encoded and zero-padded, so the id
+# an operator sees at /debug/traces on the client is the byte-identical
+# id on the server — no lossy re-mapping. Ids longer than 16 bytes
+# (already-W3C remote ids re-propagated downstream) pass through as raw
+# 32-hex. The span-id field is informational (we propagate trace
+# identity, not parent-span causality — span nesting is reconstructed
+# from wall time).
+
+TRACEPARENT_HEADER = "traceparent"
+
+_TP_VERSION = "00"
+
+
+def make_traceparent(trace: Trace | None) -> str | None:
+    """Render ``trace``'s id as a traceparent string, or None when
+    there is nothing to propagate (no trace, or KTPU_PROPAGATE=0)."""
+    if trace is None or not propagate_enabled():
+        return None
+    tid = trace.trace_id
+    raw = tid.encode()
+    if len(raw) <= 16:
+        hex32 = raw.hex().ljust(32, "0")
+    elif len(tid) == 32 and all(c in "0123456789abcdef" for c in tid):
+        hex32 = tid                      # already a W3C-format id
+    else:
+        import hashlib
+
+        hex32 = hashlib.blake2b(raw, digest_size=16).hexdigest()
+    return f"{_TP_VERSION}-{hex32}-{trace.seq & 0xFFFFFFFFFFFFFFFF:016x}-01"
+
+
+def parse_traceparent(value) -> str | None:
+    """Native trace id carried by a traceparent string, or None when the
+    header is absent/malformed (the caller keeps its local id). Inverse
+    of :func:`make_traceparent` for ids we minted; foreign W3C ids come
+    back as their raw 32-hex form."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32:
+        return None
+    hex32 = parts[1].lower()
+    if any(c not in "0123456789abcdef" for c in hex32):
+        return None
+    if hex32 == "0" * 32:
+        return None                      # invalid per the W3C spec
+    try:
+        raw = bytes.fromhex(hex32).rstrip(b"\x00")
+        decoded = raw.decode("ascii")
+        # our minted ids are printable "<hex>-<hex>"; anything else is a
+        # foreign id and keeps its 32-hex spelling
+        if decoded and all(33 <= b < 127 for b in raw):
+            return decoded
+    except (ValueError, UnicodeDecodeError):
+        pass
+    return hex32
+
+
+def adopt_remote_id(trace: Trace | None, remote_id: str | None) -> bool:
+    """Install a propagated trace id onto a locally-started trace, so
+    the client-side and server-side halves of one admission export under
+    a single id. Must run before the trace's id is first read. Returns
+    True when adopted."""
+    if trace is None or not remote_id or not propagate_enabled():
+        return False
+    trace._trace_id = remote_id
+    trace.labels.setdefault("remote", "1")
+    return True

@@ -182,10 +182,19 @@ class WebhookServer:
         else:
             return _admission_response(uid, False, f"unknown path {path}")
 
+        elapsed = time.monotonic() - start
         metrics_mod.record_admission_review_duration(
-            self.registry, operation, kind, time.monotonic() - start)
+            self.registry, operation, kind, elapsed)
         metrics_mod.record_admission_request(
             self.registry, operation, kind, out["response"]["allowed"])
+        # SLO watchdog feed: one sample per finished review (lock-free
+        # append; pure observation — KTPU_SLO=0 makes it a no-op)
+        try:
+            from .slo import watchdog
+
+            watchdog().observe(elapsed)
+        except Exception:
+            pass
         return out
 
     # ------------------------------------------------------------ contexts
@@ -446,9 +455,18 @@ class WebhookServer:
             roles, cluster_roles = info.roles, info.cluster_roles
         except Exception:
             pass
-        return {"request": request, "namespace_labels": namespace_labels,
-                "roles": roles, "cluster_roles": cluster_roles,
-                "exclude_group_role": self.config.get_exclude_group_role()}
+        payload = {"request": request,
+                   "namespace_labels": namespace_labels,
+                   "roles": roles, "cluster_roles": cluster_roles,
+                   "exclude_group_role":
+                       self.config.get_exclude_group_role()}
+        # trace context rides the payload into the host lane / oracle
+        # pool so pool-resolved spans attribute back to this admission's
+        # id (workers ignore the key; evaluate_payload unpacks by name)
+        tp = tracing.make_traceparent(tracing.current())
+        if tp:
+            payload["traceparent"] = tp
+        return payload
 
     def _subst_context(self, request: dict, resource: dict):
         """Admission-scoped substitution context for deny-message
@@ -1066,6 +1084,13 @@ class WebhookServer:
                 rec = tracing.recorder()
                 trace = rec.start("admission", path=self.path,
                                   transport="http")
+                # cross-process propagation: a caller that sent a W3C
+                # traceparent header owns the trace id — this hop's
+                # spans export under the caller's id at /debug/traces
+                remote = tracing.parse_traceparent(
+                    self.headers.get(tracing.TRACEPARENT_HEADER))
+                if remote:
+                    tracing.adopt_remote_id(trace, remote)
                 tok = tracing.bind(trace) if trace is not None else None
                 try:
                     review = json.loads(self.rfile.read(length) or b"{}")
