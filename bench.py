@@ -1947,7 +1947,9 @@ def main() -> None:
     # KTPU_BENCH_CONFIGS=1,3 runs a subset (dev convenience; the default
     # — unset — runs all five, and published numbers always come from a
     # full run)
-    only = {s for s in os.environ.get("KTPU_BENCH_CONFIGS", "").split(",")
+    from kyverno_tpu.runtime import featureplane
+
+    only = {s for s in featureplane.raw("KTPU_BENCH_CONFIGS").split(",")
             if s.strip()}
     configs = {}
     for name, f in (("1_single_pod_latency", bench_config1),

@@ -1,0 +1,191 @@
+"""Cross-layer certification smoke for CI (deploy/ci_lint.sh).
+
+Gates, in order:
+
+1. **Corpus certification** — every rule in tests/policies either
+   certifies clean, is host-escalated, or is explicitly counted
+   KT404-incomplete. Zero KT401 divergences allowed.
+2. **Detector self-test** — seeded corruptions of assembled tensors
+   (flipped group negation, flipped boolean literal, rewired alt) MUST
+   each produce a KT401; a certifier that can't see planted divergence
+   is vacuous.
+3. **Discharge probe** — a hand-escalated device-decidable rule MUST
+   produce KT402 (the escalation is provably wasted), and a genuinely
+   host-only rule must NOT.
+4. **Differential fuzz** — >=1000 random policy x resource cases scored
+   through the real device kernel and the CPU oracle (plus the
+   pipelined and streaming legs): zero unexplained divergences.
+
+Exit 0 = all gates hold, 1 = any failed.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CORPUS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "policies")
+
+
+def _build(path):
+    from kyverno_tpu.api.load import load_policies_from_path
+    from kyverno_tpu.models.compiler import (TensorDictionary,
+                                             assemble_tensors,
+                                             compile_segment)
+    from kyverno_tpu.models.ir import compile_rule_ir
+
+    pols = load_policies_from_path(path)
+    p = pols[0]
+    vrules = [r for r in p.spec.rules if r.has_validate()]
+    irs = [compile_rule_ir(p, r, i) for i, r in enumerate(vrules)]
+    d = TensorDictionary()
+    seg = compile_segment(irs, d, name=p.name)
+    return p, irs, assemble_tensors([seg], d)
+
+
+def gate_corpus() -> list[str]:
+    from kyverno_tpu.analysis.certify import certify_policies
+    from kyverno_tpu.api.load import load_policies_from_path
+
+    failures = []
+    policies = load_policies_from_path(CORPUS)
+    if not policies:
+        return [f"no policies found under {CORPUS}"]
+    res = certify_policies(policies)
+    counts = res.counts()
+    if res.divergences:
+        failures.extend(
+            f"corpus KT401: {d.format()}" for d in res.divergences[:5])
+    undischarged = sum(1 for s in res.statuses.values()
+                       if s not in ("certified", "incomplete", "host"))
+    if undischarged:
+        failures.append(
+            f"{undischarged} rule(s) neither certified, host, nor "
+            f"KT404-counted: {counts}")
+    if not counts.get("certified"):
+        failures.append(f"no rule certified at all: {counts}")
+    print(f"certify_smoke: corpus {counts}, "
+          f"{res.states_checked} states, "
+          f"{res.escalation_cells} escalation cells, "
+          f"{sum(1 for d in res.diagnostics if d.code == 'KT404')} "
+          f"KT404, {sum(1 for d in res.diagnostics if d.code == 'KT403')} "
+          f"KT403")
+    return failures
+
+
+def gate_detector() -> list[str]:
+    import numpy as np
+
+    from kyverno_tpu.analysis.certify import certify_tensors
+
+    failures = []
+
+    # flipped aux-group negation on the deny-constant sample
+    _, _, t = _build(os.path.join(CORPUS, "sample_deny_constant.yaml"))
+    t.axg_negate = np.array(t.axg_negate).copy()
+    t.axg_negate[0] = not t.axg_negate[0]
+    r = certify_tensors(t)
+    if not any(d.code == "KT401" for d in r.diagnostics):
+        failures.append("planted group-negate corruption not detected")
+
+    # flipped boolean literal on the clean sample's runAsNonRoot rule
+    _, _, t = _build(os.path.join(CORPUS, "sample_clean.yaml"))
+    bools = np.array(t.chk_bool).copy()
+    ops = np.array(t.chk_op)
+    flipped = False
+    from kyverno_tpu.models.ir import CheckOp
+    for i in range(len(ops)):
+        if int(ops[i]) == int(CheckOp.BOOL_EQ):
+            bools[i] = not bools[i]
+            flipped = True
+            break
+    t.chk_bool = bools
+    r = certify_tensors(t)
+    if not flipped:
+        failures.append("no BOOL_EQ row found to corrupt")
+    elif not any(d.code == "KT401" for d in r.diagnostics):
+        failures.append("planted boolean-literal corruption not detected")
+
+    # rewired alt -> wrong rule row (structural)
+    _, _, t = _build(os.path.join(CORPUS, "sample_clean.yaml"))
+    t.alt_rule = np.array(t.alt_rule).copy()
+    t.alt_rule[0] = (int(t.alt_rule[0]) + 1) % max(2, t.n_rules_logical)
+    r = certify_tensors(t)
+    if not any(d.code == "KT401" for d in r.diagnostics):
+        failures.append("planted alt rewiring not detected")
+    return failures
+
+
+def gate_discharge() -> list[str]:
+    from kyverno_tpu.analysis.certify import certify_tensors
+    from kyverno_tpu.api.load import load_policies_from_path
+    from kyverno_tpu.models.compiler import (TensorDictionary,
+                                             assemble_tensors,
+                                             compile_segment)
+    from kyverno_tpu.models.ir import compile_rule_ir
+
+    failures = []
+    # device-decidable rule force-escalated -> must flag KT402
+    pols = load_policies_from_path(
+        os.path.join(CORPUS, "sample_deny_constant.yaml"))
+    p = pols[0]
+    vrules = [r for r in p.spec.rules if r.has_validate()]
+    irs = [compile_rule_ir(p, r, i) for i, r in enumerate(vrules)]
+    irs[0].host_only = True
+    irs[0].host_reason = "smoke: forced escalation"
+    d = TensorDictionary()
+    t = assemble_tensors([compile_segment(irs, d, name=p.name)], d)
+    r = certify_tensors(t)
+    if not any(x.code == "KT402" for x in r.diagnostics):
+        failures.append("forced escalation not flagged KT402")
+
+    # genuinely host rule (variables) -> must NOT flag KT402
+    pols = load_policies_from_path(
+        os.path.join(CORPUS, "sample_host_variable.yaml"))
+    p = pols[0]
+    vrules = [r for r in p.spec.rules if r.has_validate()]
+    irs = [compile_rule_ir(p, r, i) for i, r in enumerate(vrules)]
+    d = TensorDictionary()
+    t = assemble_tensors([compile_segment(irs, d, name=p.name)], d)
+    r = certify_tensors(t)
+    if any(x.code == "KT402" for x in r.diagnostics):
+        failures.append("genuinely host rule wrongly flagged KT402")
+    return failures
+
+
+def gate_fuzz(cases: int = 1000) -> list[str]:
+    from kyverno_tpu.analysis.difffuzz import run_fuzz
+
+    report = run_fuzz(cases=cases)
+    print(f"certify_smoke: fuzz {report.cases} cases, "
+          f"{report.device_cells} device cells, "
+          f"{report.escalated_cells} escalated, "
+          f"{report.messages_checked} messages, "
+          f"{report.stream_rows} stream rows")
+    if report.cases < cases:
+        return [f"fuzz stopped at {report.cases}/{cases} cases"]
+    return [d.format() for d in report.diagnostics()[:5]]
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    cases = int(argv[0]) if argv else 1000
+    failures = []
+    failures += gate_corpus()
+    failures += gate_detector()
+    failures += gate_discharge()
+    failures += gate_fuzz(cases)
+    if failures:
+        print("certify_smoke: FAILED")
+        for f in failures[:20]:
+            print("  -", f)
+        return 1
+    print("certify_smoke: OK (corpus certified, planted corruptions "
+          "detected, discharge probe sound, fuzz parity holds)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,16 +11,24 @@ rc=0
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check"
-    ruff check kyverno_tpu tests bench.py || rc=1
+    ruff check kyverno_tpu tests deploy bench.py || rc=1
 else
     echo "== ruff not installed; skipping python hygiene pass"
 fi
 
-echo "== analyzer self-smoke (kyverno-tpu lint --self)"
-python -m kyverno_tpu.cli lint --self --fail-on error >/dev/null || rc=1
+echo "== analyzer self-smoke (kyverno-tpu lint --self --certify)"
+python -m kyverno_tpu.cli lint --self --certify --fail-on error >/dev/null || rc=1
 
 echo "== policy static analysis (fail on ERROR diagnostics)"
 python -m kyverno_tpu.cli lint --fail-on error "${@:-tests/policies}" || rc=1
+
+echo "== feature-lane lint (KT5xx: KTPU_* switch matrix closed)"
+python -m kyverno_tpu.analysis.featurelint || rc=1
+
+# CI_LINT_FUZZ_CASES trims the differential fuzz for callers on a test
+# budget (the lint-CLI battery); real CI keeps the >=1000-case default.
+echo "== certifier smoke (KT4xx corpus + detector self-test + differential fuzz)"
+JAX_PLATFORMS=cpu python deploy/certify_smoke.py "${CI_LINT_FUZZ_CASES:-1000}" || rc=1
 
 echo "== pipeline parity smoke (serial vs pipelined dataflow)"
 JAX_PLATFORMS=cpu python deploy/pipeline_smoke.py || rc=1

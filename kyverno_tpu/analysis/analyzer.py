@@ -57,7 +57,23 @@ def analyze_policies(policies, include_tensors: bool = True,
         tensor_diags += _check_incremental(policies)
         report.diagnostics += [d for d in tensor_diags
                                if d.code not in global_suppress]
+    _export_findings(report.diagnostics)
     return report
+
+
+def _export_findings(diagnostics) -> None:
+    """Feed ``kyverno_lint_findings_total{code,severity}`` — every
+    surviving diagnostic counts once, whether the caller is admission
+    lint (policycache) or the CLI. Best-effort: the analyzer stays
+    usable in contexts with no runtime package."""
+    try:
+        from ..runtime.metrics import record_lint_finding, registry
+
+        reg = registry()
+        for d in diagnostics:
+            record_lint_finding(reg, d.code, d.severity.name)
+    except Exception:
+        pass
 
 
 def _check_incremental(policies) -> list[Diagnostic]:

@@ -8,6 +8,10 @@ from the ``CODES`` registry. Codes are grouped by pass:
   constant-folded deny conditions)
 - ``KT3xx`` tensor invariants (PolicyTensors / FlatBatch geometry,
   dtypes, index bounds)
+- ``KT4xx`` cross-layer certification (compiled tensor semantics vs the
+  host IR walk over a shared abstract resource domain)
+- ``KT5xx`` feature-lane lint (every KTPU_* switch declared in the
+  runtime/featureplane.py registry, no bypassing env reads)
 
 Severities order INFO < WARNING < ERROR; the CI gate
 (deploy/ci_lint.sh) fails on ERROR. Suppression: the policy annotation
@@ -47,6 +51,15 @@ CODES: dict[str, tuple[Severity, str]] = {
     "KT311": (Severity.ERROR, "batch interner index out of range"),
     "KT312": (Severity.ERROR, "batch lane invariant violated"),
     "KT313": (Severity.ERROR, "padding-bucket invariant violated"),
+    # -- cross-layer certification (analysis/certify.py)
+    "KT401": (Severity.ERROR, "device/host verdict divergence"),
+    "KT402": (Severity.WARNING, "unsound escalation (dischargeable)"),
+    "KT403": (Severity.WARNING, "deny-message lane divergence"),
+    "KT404": (Severity.INFO, "certification incomplete"),
+    # -- feature-lane lint (analysis/featurelint.py)
+    "KT501": (Severity.ERROR, "undeclared KTPU_* switch read"),
+    "KT502": (Severity.ERROR, "dead featureplane declaration"),
+    "KT503": (Severity.ERROR, "env read bypasses featureplane"),
 }
 
 SUPPRESS_ANNOTATION = "kyverno-tpu.io/lint-suppress"

@@ -60,8 +60,22 @@ def run(args) -> int:
                               include_tensors=not args.no_tensors,
                               suppress=suppress)
 
+    certify_counts = None
+    if args.certify:
+        from ..analysis.certify import certify_policies
+
+        cert = certify_policies(policies)
+        report.diagnostics += [d for d in cert.diagnostics
+                               if d.code not in suppress]
+        certify_counts = cert.counts()
+        certify_counts["states_checked"] = cert.states_checked
+        certify_counts["escalation_cells"] = cert.escalation_cells
+
     if args.json:
-        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+        out = report.to_dict()
+        if certify_counts is not None:
+            out["certification"] = certify_counts
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         for d in sorted(report.diagnostics,
                         key=lambda d: (-d.severity, d.policy, d.rule, d.code)):
@@ -71,6 +85,10 @@ def run(args) -> int:
               f"{counts[Severity.ERROR]} errors, "
               f"{counts[Severity.WARNING]} warnings, "
               f"{counts[Severity.INFO]} info")
+        if certify_counts is not None:
+            summary = ", ".join(
+                f"{k}={v}" for k, v in sorted(certify_counts.items()))
+            print(f"certify: {summary}")
 
     threshold = _FAIL_LEVELS[args.fail_on]
     if threshold is None:
@@ -94,6 +112,10 @@ def register(subparsers) -> None:
                    "(e.g. KT202,KT110)")
     p.add_argument("--no-tensors", action="store_true",
                    help="skip the PolicyTensors invariant pass")
+    p.add_argument("--certify", action="store_true",
+                   help="run the KT4xx cross-layer certifier (device "
+                   "tensor program vs host IR walk over an abstract "
+                   "resource domain)")
     p.add_argument("--self", dest="self_check", action="store_true",
                    help="lint the repo's own sample policies "
                    f"({SELF_POLICY_DIR}) as a smoke check")

@@ -30,12 +30,12 @@ the north star (BASELINE.json) — the TPU analogue of
 
 from __future__ import annotations
 
-import os
 import uuid
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..runtime import featureplane
 from .ir import (
     AUX_DENY,
     AUX_EXCLUDE,
@@ -62,7 +62,7 @@ def incremental_enabled() -> bool:
     survival and rule-axis bucketing everywhere — every policy change
     then rebuilds its population from scratch (the pre-storm behavior).
     Read dynamically so tests can flip it per-case."""
-    return os.environ.get("KTPU_INCREMENTAL", "1") not in ("0", "false", "")
+    return featureplane.enabled_strict("KTPU_INCREMENTAL")
 
 
 class _Host(Exception):

@@ -8,7 +8,7 @@ batch on device and routes host-lane rules/resources through the CPU oracle
 
 from __future__ import annotations
 
-import os
+import logging
 import time
 import warnings
 from dataclasses import dataclass
@@ -20,6 +20,7 @@ from ..engine.context import Context
 from ..engine.policy_context import PolicyContext
 from ..engine.response import RuleStatus
 from ..engine.validation import validate as oracle_validate
+from ..runtime import featureplane
 from .compiler import (
     PolicyTensors,
     TensorDictionary,
@@ -29,6 +30,8 @@ from .compiler import (
 )
 from .flatten import FlatBatch
 from .ir import compile_rule_ir
+
+logger = logging.getLogger(__name__)
 
 
 class Verdict(IntEnum):
@@ -52,7 +55,7 @@ _STATUS_TO_VERDICT = {
 def donation_enabled() -> bool:
     """KTPU_DONATE=0 kill switch for input-buffer donation on the
     stable-shape device call — dynamic, like every KTPU_* lane flag."""
-    return os.environ.get("KTPU_DONATE", "1") != "0"
+    return featureplane.enabled("KTPU_DONATE")
 
 
 # process-wide donation accounting (read by deploy/stream_smoke.py and
@@ -532,6 +535,7 @@ class IncrementalCompiler:
         self.stats = {"refreshes": 0, "segments_reused": 0,
                       "segments_recompiled": 0, "segments_dropped": 0}
         self.last_refresh: dict = {}
+        self.last_refresh_certify: dict = {}
 
     @staticmethod
     def _policy_key(policy) -> str:
@@ -590,6 +594,7 @@ class IncrementalCompiler:
                                    rule_bucket=self.rule_bucket)
         cps = CompiledPolicySet(policies,
                                 _parts=(rule_refs, rule_irs, tensors))
+        self._certify_spliced(tensors)
         self.stats["segments_reused"] += reused
         self.stats["segments_recompiled"] += len(recompiled_keys)
         self.stats["segments_dropped"] += len(dropped)
@@ -602,6 +607,44 @@ class IncrementalCompiler:
         self._last = cps
         self._last_sig = sig
         return cps
+
+    def _certify_spliced(self, tensors: PolicyTensors) -> None:
+        """KT4xx certification of the freshly spliced tensors, gated on
+        KTPU_CERTIFY. Only rules not yet stamped are certified (cached
+        segments carry their stamp across refreshes), so a storm of
+        single-policy updates pays one rule's worth of abstract
+        enumeration per splice, not the population's. Never raises: a
+        certifier failure must not take down admission; it surfaces as
+        the ``kyverno_certified_rules{status="divergent"}`` gauge and an
+        error log instead."""
+        try:
+            if not featureplane.enabled("KTPU_CERTIFY"):
+                return
+            from ..analysis.certify import certify_tensors
+
+            result = certify_tensors(
+                tensors, rule_filter=lambda ir: not ir.certified,
+                probe_discharge=False)
+            by_key = {(ir.policy_name, ir.rule_name): ir
+                      for ir in tensors.rules}
+            for key, status in result.statuses.items():
+                ir = by_key.get(key)
+                if ir is not None:
+                    ir.certified = status
+            for d in result.diagnostics:
+                if d.code == "KT401":
+                    logger.error("certify: %s", d.format())
+            counts: dict[str, int] = {}
+            for ir in tensors.rules:
+                counts[ir.certified or "unchecked"] = (
+                    counts.get(ir.certified or "unchecked", 0) + 1)
+            self.last_refresh_certify = counts
+            from ..runtime.metrics import record_certified_rules, registry
+
+            record_certified_rules(registry(), counts)
+        except Exception:
+            logger.exception("certification of spliced segments failed "
+                             "(admission unaffected)")
 
     def subset(self, policies: list) -> CompiledPolicySet:
         """Compiled set over a *subset* of the population, assembled from

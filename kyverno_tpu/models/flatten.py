@@ -26,13 +26,13 @@ dedup that makes the string path cheap on device.
 
 from __future__ import annotations
 
-import os
 import struct
 import time
 from dataclasses import dataclass
 
 import numpy as np
 
+from ..runtime import featureplane
 from ..utils.duration import DurationError, parse_duration
 from ..utils.gofmt import value_to_string_for_equality
 from ..utils.quantity import QuantityError, parse_quantity
@@ -414,7 +414,7 @@ def pipeline_enabled() -> bool:
     site so an operator (or a test monkeypatching os.environ) can drop the
     whole admission/scan path back to the serial dataflow without a
     restart."""
-    return os.environ.get("KTPU_FLATTEN_PIPELINE", "1") != "0"
+    return featureplane.enabled("KTPU_FLATTEN_PIPELINE")
 
 
 @dataclass

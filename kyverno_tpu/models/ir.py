@@ -272,6 +272,10 @@ class RuleIR:
     precond_has_any: bool = False    # preconditions carry an any-block
     is_deny: bool = False
     deny_has_any: bool = False
+    # KT4xx certification status stamped by analysis/certify.py via the
+    # IncrementalCompiler refresh hook ("" = never certified; else
+    # "certified" | "incomplete" | "host" | "divergent")
+    certified: str = ""
 
 
 _HAS_VAR = re.compile("|".join([REGEX_VARIABLES.pattern, REGEX_REFERENCES.pattern]))

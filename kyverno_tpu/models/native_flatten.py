@@ -24,6 +24,7 @@ from pathlib import Path
 
 import numpy as np
 
+from ..runtime import featureplane
 from .compiler import STR_LEN, PolicyTensors
 from .flatten import FlatBatch, flatten_batch, merge_packed
 from .ir import NSEFF_MARK, REQ_MARK
@@ -147,7 +148,7 @@ def _load_lib():
 
 
 def native_available() -> bool:
-    return os.environ.get("KTPU_NATIVE", "1") != "0" and _load_lib() is not None
+    return featureplane.enabled("KTPU_NATIVE") and _load_lib() is not None
 
 
 def _ptr(a: np.ndarray):
@@ -518,7 +519,7 @@ _CHUNK_MIN = 512                    # below this, chunking costs more than it sa
 
 def _chunk_workers() -> int:
     try:
-        n = int(os.environ.get("KTPU_FLATTEN_WORKERS", "0"))
+        n = featureplane.int_value("KTPU_FLATTEN_WORKERS")
     except ValueError:
         n = 0
     return n if n > 0 else min(4, os.cpu_count() or 1)

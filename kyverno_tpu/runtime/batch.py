@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import contextlib
 import itertools
-import os
 import threading
 import time
 from collections import deque
@@ -40,7 +39,7 @@ from concurrent.futures import TimeoutError as FuturesTimeout
 import numpy as np
 
 from ..models import Verdict
-from . import tracing
+from . import featureplane, tracing
 
 CLEAN = "clean"          # every cell PASS/SKIP/NOT_APPLICABLE
 ATTENTION = "attention"  # some cell FAIL/ERROR/HOST -> oracle lane
@@ -58,7 +57,7 @@ def stream_enabled() -> bool:
     the window-flush semantics bit for bit (a forming batch closes at
     drain time; nothing joins a flush after padding). Dynamic, like
     every KTPU_* lane flag."""
-    return os.environ.get("KTPU_STREAM", "1") != "0"
+    return featureplane.enabled("KTPU_STREAM")
 
 
 def ttl_store(cache: dict, key, ttl_s: float, value: tuple,

@@ -1,0 +1,170 @@
+"""Feature-lane registry: the single source of truth for KTPU_* switches.
+
+Every runtime kill switch / tuning knob the engine reads from the
+environment is declared here with an owning module and a named parity
+gate (the smoke or test battery that proves both positions of the switch
+produce identical verdicts). The KT5xx feature-lane lint
+(analysis/featurelint.py) statically enumerates every ``KTPU_*`` read in
+the tree and fails CI when a read names an undeclared switch, a
+declaration has no remaining read site (dead), or a module reads
+``os.environ`` directly instead of going through the accessors below.
+
+Reads stay *dynamic* (per call, not cached) — the historical contract of
+every lane flag is that flipping it mid-process takes effect at the next
+use, and centralizing the reads here makes that observation consistent
+across lanes instead of each module hand-rolling its own
+``os.environ.get`` with a drifting default.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Switch:
+    name: str       # KTPU_* environment variable
+    owner: str      # module whose behavior the switch controls
+    gate: str       # named parity gate proving both switch positions
+    default: str    # value when the variable is unset
+    doc: str        # one-line description
+
+
+_S = Switch
+
+# Declaration order groups by plane; append-only by convention (the KT502
+# dead-declaration lint forces removal when the last read site goes away).
+REGISTRY: dict[str, Switch] = {s.name: s for s in (
+    # -- compile plane
+    _S("KTPU_INCREMENTAL", "kyverno_tpu.models.compiler",
+       "deploy/storm_smoke.py", "1",
+       "segment splicing, epoch-keyed memo survival, rule bucketing"),
+    _S("KTPU_COMPILE_CACHE", "kyverno_tpu.utils.compilecache",
+       "tests/ops/test_eval.py", "1",
+       "persistent XLA compilation cache (accelerator backends)"),
+    _S("KTPU_COMPILE_CACHE_DIR", "kyverno_tpu.utils.compilecache",
+       "tests/ops/test_eval.py", "",
+       "override the persistent compile-cache directory"),
+    _S("KTPU_CERTIFY", "kyverno_tpu.models.engine",
+       "deploy/certify_smoke.py", "1",
+       "KT4xx cross-layer certification of spliced segments on refresh"),
+    # -- flatten plane
+    _S("KTPU_NATIVE", "kyverno_tpu.models.native_flatten",
+       "tests/ops/test_native_flatten.py", "1",
+       "C flattener fast path (python fallback when off)"),
+    _S("KTPU_FLATTEN_WORKERS", "kyverno_tpu.models.native_flatten",
+       "tests/ops/test_native_flatten.py", "0",
+       "native flatten worker threads (0 = serial direct path)"),
+    _S("KTPU_FLATTEN_PIPELINE", "kyverno_tpu.models.flatten",
+       "deploy/pipeline_smoke.py", "1",
+       "overlapped flatten/dispatch pipeline with row memo"),
+    # -- host lane
+    _S("KTPU_HOST_PREFETCH", "kyverno_tpu.runtime.hostlane",
+       "deploy/host_parity_smoke.py", "1",
+       "predictive host-verdict prefetch at device dispatch time"),
+    _S("KTPU_HOST_MEMO", "kyverno_tpu.runtime.hostlane",
+       "deploy/host_parity_smoke.py", "1",
+       "content-addressed host verdict memoization"),
+    _S("KTPU_HOST_FANOUT", "kyverno_tpu.runtime.hostlane",
+       "deploy/host_parity_smoke.py", "1",
+       "oracle pool fan-out for multi-resource host resolution"),
+    # -- streaming plane
+    _S("KTPU_STREAM", "kyverno_tpu.runtime.batch",
+       "deploy/stream_smoke.py", "1",
+       "continuous batching admission lane"),
+    _S("KTPU_STREAM_TRANSPORT", "kyverno_tpu.runtime.stream_server",
+       "deploy/stream_smoke.py", "auto",
+       "stream transport selection (grpc|socket|auto)"),
+    _S("KTPU_DONATE", "kyverno_tpu.models.engine",
+       "deploy/stream_smoke.py", "1",
+       "input-buffer donation on the stable-shape device call"),
+    # -- observability plane
+    _S("KTPU_TRACE", "kyverno_tpu.runtime.tracing",
+       "deploy/trace_smoke.py", "1",
+       "admission span recorder"),
+    _S("KTPU_PROPAGATE", "kyverno_tpu.runtime.tracing",
+       "deploy/obs_smoke.py", "1",
+       "cross-process trace-context propagation"),
+    _S("KTPU_ATTRIB", "kyverno_tpu.runtime.tracing",
+       "deploy/obs_smoke.py", "1",
+       "per-policy attribution metrics"),
+    _S("KTPU_ATTRIB_TOP_K", "kyverno_tpu.runtime.metrics",
+       "deploy/obs_smoke.py", "64",
+       "distinct (policy, rule) series before attribution overflow"),
+    _S("KTPU_SLO", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "1",
+       "SLO burn-rate watchdog (observation only)"),
+    _S("KTPU_SLO_BUDGET_S", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "10.0",
+       "admission deadline budget in seconds"),
+    _S("KTPU_SLO_WINDOW_SHORT_S", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "60",
+       "short burn window in seconds"),
+    _S("KTPU_SLO_WINDOW_LONG_S", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "600",
+       "long burn window in seconds"),
+    _S("KTPU_SLO_BURN_DEGRADED", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "1.0",
+       "burn-rate threshold for the degraded state"),
+    _S("KTPU_SLO_MIN_SAMPLES", "kyverno_tpu.runtime.slo",
+       "deploy/obs_smoke.py", "8",
+       "samples before a burn window votes"),
+    _S("KTPU_PROFILE_PORT", "kyverno_tpu.runtime.profiling",
+       "deploy/obs_smoke.py", "0",
+       "on-demand profiler listener port (0 = disabled)"),
+    # -- webhook config
+    _S("KTPU_WEBHOOK_TIMEOUT_S", "kyverno_tpu.runtime.webhookconfig",
+       "tests/runtime/test_webhookconfig.py", "",
+       "webhook timeoutSeconds override"),
+    _S("KTPU_DEFAULT_FAILURE_POLICY", "kyverno_tpu.runtime.webhookconfig",
+       "tests/runtime/test_webhookconfig.py", "",
+       "failurePolicy when policies don't pin one"),
+    # -- bench driver
+    _S("KTPU_BENCH_CONFIGS", "bench",
+       "bench.py --smoke", "",
+       "comma-separated bench config subset to run"),
+)}
+
+
+def declared(name: str) -> Switch | None:
+    return REGISTRY.get(name)
+
+
+def raw(name: str, default: str | None = None) -> str:
+    """Dynamic env read of a *declared* switch; the registry default
+    applies when the variable is unset (``default`` overrides it for the
+    rare call site whose historical fallback differs)."""
+    spec = REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(f"undeclared feature switch {name!r}; declare it "
+                       "in runtime/featureplane.py")
+    if default is None:
+        default = spec.default
+    return os.environ.get(name, default)
+
+
+def is_set(name: str) -> bool:
+    """Whether the switch is explicitly present in the environment."""
+    if name not in REGISTRY:
+        raise KeyError(f"undeclared feature switch {name!r}")
+    return name in os.environ
+
+
+def enabled(name: str) -> bool:
+    """The dominant kill-switch convention: anything but "0" is on."""
+    return raw(name) != "0"
+
+
+def enabled_strict(name: str) -> bool:
+    """The stricter convention (KTPU_INCREMENTAL): "0", "false" and the
+    empty string all disable."""
+    return raw(name) not in ("0", "false", "")
+
+
+def int_value(name: str) -> int:
+    return int(raw(name))
+
+
+def float_value(name: str) -> float:
+    return float(raw(name))

@@ -41,20 +41,20 @@ import threading
 import time
 
 from ..models.engine import Verdict, _STATUS_TO_VERDICT
-from . import tracing
+from . import featureplane, tracing
 from .resourcecache import HostVerdictCache
 
 
 def prefetch_enabled() -> bool:
-    return os.environ.get("KTPU_HOST_PREFETCH", "1") != "0"
+    return featureplane.enabled("KTPU_HOST_PREFETCH")
 
 
 def memo_enabled() -> bool:
-    return os.environ.get("KTPU_HOST_MEMO", "1") != "0"
+    return featureplane.enabled("KTPU_HOST_MEMO")
 
 
 def fanout_enabled() -> bool:
-    return os.environ.get("KTPU_HOST_FANOUT", "1") != "0"
+    return featureplane.enabled("KTPU_HOST_FANOUT")
 
 
 _cache: HostVerdictCache | None = None

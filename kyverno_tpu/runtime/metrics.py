@@ -10,10 +10,11 @@ A periodic reset clears the registry like PromConfig's cron (metrics.go:17).
 from __future__ import annotations
 
 import bisect
-import os
 import platform
 import threading
 import time
+
+from . import featureplane
 
 METRIC_NAMES = (
     "kyverno_policy_results_total",
@@ -575,7 +576,7 @@ def attrib_top_k() -> int:
     tests/smokes can shrink it; shrinking does not retract already
     admitted pairs."""
     try:
-        return max(1, int(os.environ.get("KTPU_ATTRIB_TOP_K", "64")))
+        return max(1, featureplane.int_value("KTPU_ATTRIB_TOP_K"))
     except ValueError:
         return 64
 
@@ -747,6 +748,47 @@ def attribution_snapshot(limit: int = 0) -> dict:
                          for p, r, t in tail[:32]],
             "tenants": {ns: dict(v) for ns, v in st.tenants.items()},
         }
+
+
+# ------------------------------------------------------- lint / certify
+
+
+def record_lint_finding(registry: MetricsRegistry, code: str,
+                        severity: str) -> None:
+    """One static-analysis finding (KT1xx-KT5xx); the analyzer calls
+    this per diagnostic so dashboards can rate() on lint regressions."""
+    registry.inc_counter("kyverno_lint_findings_total",
+                         {"code": code, "severity": severity})
+
+
+def record_certified_rules(registry: MetricsRegistry,
+                           counts: dict) -> None:
+    """KT4xx certification outcome of the last splice, one gauge series
+    per status ("certified" | "incomplete" | "host" | "divergent" |
+    "unchecked"). Absent statuses are zeroed so a rule population
+    shrinking out of "divergent" is visible as 0, not as a stale
+    series."""
+    for status in ("certified", "incomplete", "host", "divergent",
+                   "unchecked"):
+        registry.set_gauge("kyverno_certified_rules",
+                           {"status": status},
+                           float(counts.get(status, 0)))
+
+
+def lint_findings_snapshot(registry: MetricsRegistry) -> dict:
+    """/debug/policies payload fragment: per-code finding totals."""
+    with registry._lock:
+        series = registry._counters.get("kyverno_lint_findings_total", {})
+        out: dict = {}
+        for key, v in series.items():
+            labels = dict(key)
+            out[labels.get("code", "?")] = {
+                "severity": labels.get("severity", "?"), "total": int(v)}
+        certified = {
+            dict(k).get("status", "?"): int(v)
+            for k, v in registry._gauges.get(
+                "kyverno_certified_rules", {}).items()}
+    return {"lint_findings": out, "certified_rules": certified}
 
 
 # ------------------------------------------------------------ SLO gauges

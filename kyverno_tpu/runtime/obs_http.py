@@ -104,6 +104,8 @@ def handle_obs_get(path: str, registry=None):
             limit = 0
         payload = metrics_mod.attribution_snapshot(limit=limit)
         payload["attrib_enabled"] = tracing.attrib_enabled()
+        reg = registry if registry is not None else metrics_mod.registry()
+        payload.update(metrics_mod.lint_findings_snapshot(reg))
         return 200, json.dumps(payload).encode(), "application/json"
     if route == "/debug/profile":
         from . import profiling

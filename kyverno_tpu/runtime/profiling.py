@@ -12,10 +12,11 @@ parity), which covers the host-side view.
 from __future__ import annotations
 
 import contextlib
-import os
 import tempfile
 import threading
 import time
+
+from . import featureplane
 
 _server_started = False
 
@@ -29,7 +30,7 @@ def maybe_start_profiler(port: int | None = None) -> bool:
         return True
     if port is None:
         try:
-            port = int(os.environ.get("KTPU_PROFILE_PORT", "0"))
+            port = featureplane.int_value("KTPU_PROFILE_PORT")
         except ValueError:
             port = 0
     if not port:

@@ -39,18 +39,18 @@ the reader's thread (scrape, /healthz, watchdog consumers).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 
+from . import featureplane
 from . import metrics as metrics_mod
 from .tracing import slo_enabled
 
 
 def _env_f(name: str, default: float) -> float:
     try:
-        return float(os.environ.get(name, default))
+        return float(featureplane.raw(name))
     except ValueError:
         return default
 

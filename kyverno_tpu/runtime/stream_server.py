@@ -51,7 +51,6 @@ The three admission kinds trade generality for copies:
 from __future__ import annotations
 
 import json
-import os
 import queue
 import socket
 import struct
@@ -60,7 +59,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..models import Verdict
-from . import tracing
+from . import featureplane, tracing
 from .batch import ATTENTION, CLEAN
 from .policycache import PolicyType
 from .webhook import VALIDATING_WEBHOOK_PATH
@@ -92,7 +91,7 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024  # defensive bound on one frame
 
 def transport_preference() -> str:
     """grpc | socket | auto (the startup selection knob)."""
-    return os.environ.get("KTPU_STREAM_TRANSPORT", "auto")
+    return featureplane.raw("KTPU_STREAM_TRANSPORT")
 
 
 # ------------------------------------------------------------------ codec

@@ -44,15 +44,16 @@ import contextlib
 import contextvars
 import heapq
 import itertools
-import os
 import threading
 import time
 from collections import deque
 
+from . import featureplane
+
 
 def trace_enabled() -> bool:
     """KTPU_TRACE=0 kill switch — dynamic, like every KTPU_* lane flag."""
-    return os.environ.get("KTPU_TRACE", "1") != "0"
+    return featureplane.enabled("KTPU_TRACE")
 
 
 # the kill-switch matrix snapshot attached to every trace: which lane
@@ -74,24 +75,24 @@ _LANE_SWITCHES = (
 
 def attrib_enabled() -> bool:
     """KTPU_ATTRIB=0 kill switch for per-policy attribution metrics."""
-    return os.environ.get("KTPU_ATTRIB", "1") != "0"
+    return featureplane.enabled("KTPU_ATTRIB")
 
 
 def slo_enabled() -> bool:
     """KTPU_SLO=0 kill switch for the SLO watchdog (observation only —
     the watchdog never changes verdicts either way)."""
-    return os.environ.get("KTPU_SLO", "1") != "0"
+    return featureplane.enabled("KTPU_SLO")
 
 
 def propagate_enabled() -> bool:
     """KTPU_PROPAGATE=0 kill switch for cross-process trace-context
     propagation (stream frames, webhook headers, oracle-pool payloads)."""
-    return os.environ.get("KTPU_PROPAGATE", "1") != "0"
+    return featureplane.enabled("KTPU_PROPAGATE")
 
 
 def killswitch_lanes() -> dict:
     """{switch: "on"|"off"} for the runtime's KTPU_* lane matrix."""
-    return {name: ("off" if os.environ.get(env, "1") == "0" else "on")
+    return {name: ("on" if featureplane.enabled(env) else "off")
             for name, env in _LANE_SWITCHES}
 
 
@@ -103,7 +104,7 @@ def _lanes_label() -> str:
     snapshot — trace start is the hot path and the switches flip rarely,
     so re-rendering the string per trace is pure overhead."""
     global _lanes_cache
-    snap = tuple(os.environ.get(env, "1") == "0"
+    snap = tuple(not featureplane.enabled(env)
                  for _, env in _LANE_SWITCHES)
     cached = _lanes_cache
     if cached is not None and cached[0] == snap:

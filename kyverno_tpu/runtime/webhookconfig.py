@@ -77,7 +77,7 @@ class Register:
                  service_name: str = "kyverno-svc",
                  timeout_s: int = 0,
                  default_failure_policy: str = ""):
-        import os
+        from . import featureplane
 
         self.client = client
         self.ca_bundle = ca_bundle
@@ -90,7 +90,7 @@ class Register:
 
         log = logging.getLogger("kyverno.webhookconfig")
         if not timeout_s:
-            raw = os.environ.get("KTPU_WEBHOOK_TIMEOUT_S", "")
+            raw = featureplane.raw("KTPU_WEBHOOK_TIMEOUT_S")
             try:
                 timeout_s = int(raw) if raw else DEFAULT_WEBHOOK_TIMEOUT_S
             except ValueError:
@@ -102,7 +102,7 @@ class Register:
         # the catch-all resource webhooks default to Ignore like the
         # reference's; Fail closes the cluster on controller outage
         fp = (default_failure_policy
-              or os.environ.get("KTPU_DEFAULT_FAILURE_POLICY", "")
+              or featureplane.raw("KTPU_DEFAULT_FAILURE_POLICY")
               or "Ignore").capitalize()
         if fp not in ("Ignore", "Fail"):
             log.warning("invalid failurePolicy %r; using Ignore", fp)

@@ -15,8 +15,9 @@ gitignored).
 
 from __future__ import annotations
 
-import os
 from pathlib import Path
+
+from ..runtime import featureplane
 
 _enabled = False
 
@@ -25,9 +26,9 @@ def enable() -> None:
     """Idempotent; called wherever jit functions are built (ops.eval
     import). Must run before heavy compilation, not before jax import."""
     global _enabled
-    if _enabled or os.environ.get("KTPU_COMPILE_CACHE", "1") == "0":
+    if _enabled or not featureplane.enabled("KTPU_COMPILE_CACHE"):
         return
-    explicit = os.environ.get("KTPU_COMPILE_CACHE_DIR")
+    explicit = featureplane.raw("KTPU_COMPILE_CACHE_DIR") or None
     try:
         import jax
 

@@ -1,0 +1,229 @@
+"""KT4xx cross-layer certifier battery.
+
+Golden fixtures under tests/policies/ anchor each code: the corpus
+certifies with zero KT401s, seeded tensor corruptions of
+cert_divergent_seed.yaml must surface KT401, a hand-escalated
+cert_wasted_host.yaml must surface KT402 (and a genuinely host-only
+rule must not), cert_msg_variable.yaml pins KT403, and
+cert_incomplete_list.yaml pins KT404. The fuzz-repro leg round-trips a
+divergence through its JSON repro and the greedy minimizer.
+
+Host-only: compiles IR and tensors with numpy, never imports jax.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.analysis.certify import certify_policies, certify_tensors
+from kyverno_tpu.analysis.difffuzz import (
+    Divergence,
+    divergence_to_diagnostic,
+    minimize,
+    run_fuzz,
+)
+from kyverno_tpu.api.load import load_policies_from_path
+from kyverno_tpu.models.compiler import (
+    TensorDictionary,
+    assemble_tensors,
+    compile_segment,
+)
+from kyverno_tpu.models.ir import CheckOp, compile_rule_ir
+
+POLICY_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "policies")
+
+
+def _build(name, mutate_ir=None):
+    """Compile one fixture to (policy, irs, tensors); ``mutate_ir`` runs
+    on the IR list before segment compile (for forced escalations)."""
+    p = load_policies_from_path(os.path.join(POLICY_DIR, name))[0]
+    vrules = [r for r in p.spec.rules if r.has_validate()]
+    irs = [compile_rule_ir(p, r, i) for i, r in enumerate(vrules)]
+    if mutate_ir is not None:
+        mutate_ir(irs)
+    d = TensorDictionary()
+    seg = compile_segment(irs, d, name=p.name)
+    return p, irs, assemble_tensors([seg], d)
+
+
+def _codes(result):
+    return {d.code for d in result.diagnostics}
+
+
+# ---------------------------------------------------------------- corpus
+
+
+def test_corpus_certifies_with_zero_divergences():
+    """Acceptance criterion: the certifier discharges 100% of the
+    device-decided corpus — every rule certified, host, or explicitly
+    KT404-counted, and no KT401 anywhere."""
+    res = certify_policies(load_policies_from_path(POLICY_DIR))
+    assert not res.divergences, [d.format() for d in res.divergences]
+    assert res.statuses, "corpus produced no statuses"
+    for key, status in res.statuses.items():
+        assert status in ("certified", "incomplete", "host"), (key, status)
+    counts = res.counts()
+    assert counts.get("certified", 0) >= 4
+    assert res.states_checked > 0
+
+
+def test_corpus_incomplete_rules_all_carry_kt404():
+    """KT404 is counted, never dropped: one INFO per incomplete rule."""
+    res = certify_policies(load_policies_from_path(POLICY_DIR))
+    incomplete = {k for k, s in res.statuses.items() if s == "incomplete"}
+    flagged = {(d.policy, d.rule) for d in res.diagnostics
+               if d.code == "KT404"}
+    assert incomplete <= flagged
+
+
+# ---------------------------------------------------- KT401: divergence
+
+
+def test_seeded_op_corruption_raises_kt401():
+    """Swapping the glob check's op for a boolean compare makes the
+    device program disagree with the host walk on a concrete witness."""
+    _, _, t = _build("cert_divergent_seed.yaml")
+    t.chk_op = np.array(t.chk_op).copy()
+    t.chk_op[0] = int(CheckOp.BOOL_EQ)
+    res = certify_tensors(t)
+    kt401 = [d for d in res.diagnostics if d.code == "KT401"]
+    assert kt401, _codes(res)
+    assert "device=" in kt401[0].message and "host=" in kt401[0].message
+
+
+def test_seeded_nfa_unwiring_raises_kt401():
+    """Detaching the check's NFA id leaves the device matcher unable to
+    reproduce the host glob — a divergence, not a silent skip."""
+    _, _, t = _build("cert_divergent_seed.yaml")
+    t.chk_nfa = np.array(t.chk_nfa).copy()
+    t.chk_nfa[0] = -1
+    res = certify_tensors(t)
+    assert any(d.code == "KT401" for d in res.diagnostics), _codes(res)
+
+
+def test_pristine_seed_fixture_certifies_clean():
+    _, _, t = _build("cert_divergent_seed.yaml")
+    res = certify_tensors(t)
+    assert not res.divergences, [d.format() for d in res.divergences]
+    assert list(res.statuses.values()) == ["certified"]
+
+
+# ------------------------------------------- KT402: wasted escalation
+
+
+def test_forced_escalation_raises_kt402():
+    def escalate(irs):
+        irs[0].host_only = True
+        irs[0].host_reason = "test: forced escalation"
+
+    _, _, t = _build("cert_wasted_host.yaml", mutate_ir=escalate)
+    res = certify_tensors(t)
+    assert any(d.code == "KT402" for d in res.diagnostics), _codes(res)
+    assert res.statuses[("cert-wasted-host", "pin-replica-floor")] == "host"
+
+
+def test_genuine_host_rule_not_flagged_kt402():
+    """sample_host_variable's variable-reference rule re-escalates when
+    recompiled from scratch — the discharge probe must stay silent."""
+    _, _, t = _build("sample_host_variable.yaml")
+    res = certify_tensors(t)
+    assert not any(d.code == "KT402" for d in res.diagnostics), _codes(res)
+    assert res.statuses[("sample-host-variable", "label-matches-name")] == \
+        "host"
+
+
+def test_probe_discharge_flag_gates_kt402():
+    def escalate(irs):
+        irs[0].host_only = True
+        irs[0].host_reason = "test: forced escalation"
+
+    _, _, t = _build("cert_wasted_host.yaml", mutate_ir=escalate)
+    res = certify_tensors(t, probe_discharge=False)
+    assert not any(d.code == "KT402" for d in res.diagnostics)
+
+
+# ------------------------------------------- KT403: message divergence
+
+
+def test_variable_message_raises_kt403_but_still_certifies():
+    pols = load_policies_from_path(
+        os.path.join(POLICY_DIR, "cert_msg_variable.yaml"))
+    res = certify_policies(pols)
+    kt403 = [d for d in res.diagnostics if d.code == "KT403"]
+    assert kt403 and kt403[0].policy == "cert-msg-variable"
+    assert res.statuses[("cert-msg-variable", "require-priority-class")] == \
+        "certified"
+    assert not res.divergences
+
+
+# --------------------------------------------- KT404: incompleteness
+
+
+def test_list_pattern_counts_kt404_incomplete():
+    pols = load_policies_from_path(
+        os.path.join(POLICY_DIR, "cert_incomplete_list.yaml"))
+    res = certify_policies(pols)
+    kt404 = [d for d in res.diagnostics if d.code == "KT404"]
+    assert kt404, _codes(res)
+    assert "wildcard-path" in kt404[0].message
+    assert res.statuses[("cert-incomplete-list",
+                         "require-container-names")] == "incomplete"
+
+
+# ------------------------------------------------ rule_filter contract
+
+
+def test_rule_filter_skips_already_certified_rules():
+    _, _, t = _build("cert_divergent_seed.yaml")
+    res = certify_tensors(t, rule_filter=lambda ir: False)
+    assert res.statuses == {}
+    assert res.states_checked == 0
+
+
+# -------------------------------------- fuzz repro + minimizer round-trip
+
+
+def test_divergence_repro_round_trips_through_diagnostic():
+    d = Divergence(
+        leg="verdict", policy="fz-p", rule="r0", rule_index=3,
+        device="FAIL", host="PASS",
+        resource={"kind": "Pod", "spec": {"x": 1}},
+        policy_docs=[{"metadata": {"name": "fz-p"}}],
+        detail="unit")
+    diag = divergence_to_diagnostic(d)
+    assert diag.code == "KT401" and diag.policy == "fz-p"
+    repro = json.loads(diag.message.split("repro: ", 1)[1])
+    assert repro["resource"] == d.resource
+    assert repro["policies"] == d.policy_docs
+    assert repro["device"] == "FAIL" and repro["host"] == "PASS"
+
+
+def test_minimizer_shrinks_to_the_witness_subtree():
+    """The greedy shrinker must keep exactly the fields the reproducer
+    needs and drop the noise (kind/apiVersion are pinned)."""
+    resource = {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": "noisy", "labels": {"a": "1", "b": "2"}},
+        "spec": {"containers": [{"name": "c", "image": "nginx:latest"}],
+                 "hostNetwork": True},
+    }
+
+    def reproduce(doc):
+        return doc.get("spec", {}).get("hostNetwork") is True
+
+    small = minimize(None, resource, 0, reproduce)
+    assert reproduce(small)
+    assert small["spec"] == {"hostNetwork": True}
+    assert "metadata" not in small
+    assert small["kind"] == "Pod"          # identity keys survive
+
+
+@pytest.mark.slow
+def test_fuzz_shakedown_has_no_divergences():
+    report = run_fuzz(cases=60, seed=7, stream_leg=True)
+    assert report.cases >= 60          # run_fuzz rounds up to whole batches
+    assert report.ok(), [d.format() for d in report.diagnostics()]
+    assert report.device_cells > 0 and report.escalated_cells >= 0

@@ -1,0 +1,167 @@
+"""KT5xx feature-lane lint battery.
+
+Synthetic repo trees under tmp_path pin each code (KT501 undeclared
+read, KT502 dead declaration, KT503 direct environ bypass) and the
+exclusions (tests/ never scanned but counted live, writes out of
+scope). The final test runs the scanner over the real repo — the
+acceptance criterion is a closed switch matrix on the shipped tree.
+"""
+
+import os
+import subprocess
+import sys
+
+from kyverno_tpu.analysis.featurelint import scan_tree
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REGISTRY = '''\
+class Switch:
+    def __init__(self, name, default, owner, gate):
+        self.name = name
+
+_S = Switch
+REGISTRY = {
+    s.name: s for s in (
+        _S("KTPU_ALPHA", "1", "mod.a", "tests/test_a.py"),
+        _S("KTPU_BETA", "0", "mod.b", "tests/test_b.py"),
+    )
+}
+
+def enabled(name):
+    return True
+'''
+
+
+def _tree(tmp_path, registry=REGISTRY, modules=(), tests=()):
+    """Lay out a minimal scannable repo: registry + engine modules +
+    optional tests/ files; returns the root path."""
+    pkg = tmp_path / "kyverno_tpu"
+    (pkg / "runtime").mkdir(parents=True)
+    (pkg / "runtime" / "featureplane.py").write_text(registry)
+    for name, body in modules:
+        f = pkg / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+    for name, body in tests:
+        f = tmp_path / "tests" / name
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(body)
+    return tmp_path
+
+
+def _codes(diags):
+    return sorted(d.code for d in diags)
+
+
+def test_clean_tree_is_silent(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled("KTPU_ALPHA")\n'),
+        ("runtime/b.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled("KTPU_BETA")\n'),
+    ])
+    assert scan_tree(root) == []
+
+
+def test_undeclared_read_raises_kt501(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled("KTPU_ALPHA")\n'
+         'GHOST = featureplane.enabled("KTPU_GHOST")\n'),
+        ("runtime/b.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled("KTPU_BETA")\n'),
+    ])
+    diags = scan_tree(root)
+    assert _codes(diags) == ["KT501"]
+    assert "KTPU_GHOST" in diags[0].message
+    assert "runtime/a.py:3" in diags[0].message
+
+
+def test_dead_declaration_raises_kt502(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled("KTPU_ALPHA")\n'),
+    ])
+    diags = scan_tree(root)
+    assert _codes(diags) == ["KT502"]
+    assert "KTPU_BETA" in diags[0].message
+
+
+def test_test_only_reference_keeps_switch_live(tmp_path):
+    """A switch exercised only by its parity gate under tests/ is live
+    for KT502 — but tests are never scanned for KT501/KT503."""
+    root = _tree(
+        tmp_path,
+        modules=[("runtime/a.py",
+                  'from . import featureplane\n'
+                  'ON = featureplane.enabled("KTPU_ALPHA")\n')],
+        tests=[("test_b.py",
+                'import os\n'
+                'os.environ["KTPU_BETA"] = "1"\n'
+                'X = os.environ.get("KTPU_UNDECLARED_IN_TESTS")\n')])
+    assert scan_tree(root) == []
+
+
+def test_direct_environ_read_raises_kt503(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'import os\n'
+         'ON = os.environ.get("KTPU_ALPHA", "1") == "1"\n'
+         'RAW = os.environ["KTPU_BETA"]\n'),
+    ])
+    diags = scan_tree(root)
+    assert _codes(diags) == ["KT503", "KT503"]
+
+
+def test_undeclared_direct_read_raises_both(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'import os\n'
+         'A = featureplane.enabled("KTPU_ALPHA")\n'
+         'B = featureplane.enabled("KTPU_BETA")\n'
+         'G = os.getenv("KTPU_GHOST")\n'),
+    ])
+    assert _codes(scan_tree(root)) == ["KT501", "KT503"]
+
+
+def test_environ_writes_are_out_of_scope(tmp_path):
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'import os\n'
+         'from . import featureplane\n'
+         'os.environ["KTPU_ALPHA"] = "1"\n'
+         'os.environ.setdefault("KTPU_BETA", "0")\n'
+         'A = featureplane.enabled("KTPU_ALPHA")\n'
+         'B = featureplane.enabled("KTPU_BETA")\n'),
+    ])
+    assert scan_tree(root) == []
+
+
+def test_missing_registry_is_one_error(tmp_path):
+    (tmp_path / "kyverno_tpu").mkdir()
+    diags = scan_tree(tmp_path)
+    assert _codes(diags) == ["KT501"]
+    assert "registry" in diags[0].message
+
+
+def test_repo_switch_matrix_is_closed():
+    """Acceptance criterion: the shipped tree has no undeclared reads,
+    no dead declarations, no direct-environ bypasses."""
+    diags = scan_tree(REPO)
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_featurelint_module_cli_exits_clean():
+    r = subprocess.run(
+        [sys.executable, "-m", "kyverno_tpu.analysis.featurelint"],
+        cwd=REPO, text=True, capture_output=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "switch matrix closed" in r.stdout

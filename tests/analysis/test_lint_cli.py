@@ -32,10 +32,10 @@ spec:
 """
 
 
-def _run(*argv, **kw):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+def _run(*argv, timeout=120, extra_env=None, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(extra_env or {}))
     return subprocess.run(list(argv), cwd=REPO, env=env, text=True,
-                          capture_output=True, timeout=120, **kw)
+                          capture_output=True, timeout=timeout, **kw)
 
 
 def test_lint_self_smoke_exits_clean():
@@ -77,11 +77,14 @@ def test_lint_suppress_flag_drops_codes():
 def test_ci_lint_script_gates_on_injected_error(tmp_path):
     """Acceptance criterion: deploy/ci_lint.sh exits non-zero when an
     ERROR diagnostic is injected, zero on the shipped samples."""
-    clean = _run("bash", "deploy/ci_lint.sh")
+    # trimmed fuzz + generous timeout: the full smoke chain runs >100s
+    # per invocation on a loaded CI core and this test makes two.
+    budget = dict(timeout=600, extra_env={"CI_LINT_FUZZ_CASES": "120"})
+    clean = _run("bash", "deploy/ci_lint.sh", **budget)
     assert clean.returncode == 0, clean.stdout + clean.stderr
     bad = tmp_path / "dead.yaml"
     bad.write_text(DEAD_POLICY)
-    injected = _run("bash", "deploy/ci_lint.sh", str(bad))
+    injected = _run("bash", "deploy/ci_lint.sh", str(bad), **budget)
     assert injected.returncode != 0
     assert "KT201" in injected.stdout
 
