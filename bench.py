@@ -1941,6 +1941,123 @@ def bench_config9(jax):
     }
 
 
+def bench_config10(jax):
+    """Workload plane (round 11): trace replay + rollout dry-run. One
+    synthesized churn trace — Poisson arrivals with create storms, Zipf
+    namespace skew, a bounded name pool so whole bodies repeat — plays
+    through every admission leg of one serving stack at max speed, and
+    cross-leg verdict parity is asserted on the digest (not sampled:
+    every event, every leg). A larger trace then drives the background
+    leg through the real watch machinery (Reflector -> WatchHub ->
+    note_resource -> delta scans at policy-churn boundaries) to build a
+    10k-plus-row verdict matrix, and a candidate policy dry-runs against
+    that corpus with quiescence asserted fingerprint-for-fingerprint.
+    Acceptance: all four admission legs verdict-identical, the dry-run
+    touches >= 10k resources without moving the scan state."""
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.workload.dryrun import dry_run
+    from kyverno_tpu.workload.replay import (ReplayDriver, build_stack,
+                                             run_manifest)
+    from kyverno_tpu.workload.trace import synthesize
+
+    docs = [
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "disallow-latest"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "validate-image-tag",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "latest tag banned",
+                                   "pattern": {"spec": {"containers": [
+                                       {"image": "!*:latest"}]}}}}]}},
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "require-team-label"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "check-team",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "team label required",
+                                   "pattern": {"metadata": {"labels": {
+                                       "team": "?*"}}}}}]}},
+    ]
+    pols = [load_policy(d) for d in docs]
+
+    # -------- admission legs: full-digest parity on one small trace ---
+    tr = synthesize(events=120, namespaces=4, name_pool=24,
+                    distinct_bodies=12, storm_factor=8.0,
+                    storm_period=40, seed=42)
+    stack = build_stack(pols)
+    drv = ReplayDriver.from_stack(stack)
+    legs = {}
+    for leg in ("webhook", "stream_json", "stream_row", "stream_block"):
+        legs[leg] = drv.run(tr, leg, workers=8)
+    digests = {r["verdict_digest"] for r in legs.values()}
+    if len(digests) != 1:
+        raise AssertionError(
+            "cross-leg verdict parity violated: "
+            f"{ {leg: r['verdict_digest'] for leg, r in legs.items()} }")
+    manifest = run_manifest(tr, list(legs.values()), note="bench10")
+    stack["batcher"].stop()
+
+    # -------- background leg: 10k-plus corpus through the watch path --
+    churn = dict(docs[0], metadata={"name": "disallow-latest"})
+    big = synthesize(events=13_000, namespaces=8, zipf_s=1.1,
+                     distinct_bodies=48, update_fraction=0.12,
+                     delete_fraction=0.02, storm_factor=6.0,
+                     storm_period=1000, policy_docs=[churn],
+                     policy_churn_every=4000, seed=7)
+    bstack = build_stack(pols)
+    bdrv = ReplayDriver.from_stack(bstack)
+    bg = bdrv.run(big, "background")
+    scanner = bstack["scanner"]
+    corpus_rows = len(scanner._state["keys"])
+
+    # -------- rollout dry-run against the replayed corpus -------------
+    candidate = {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "block-app-3"},
+        "spec": {"validationFailureAction": "enforce",
+                 "background": True, "rules": [{
+                     "name": "no-app-3",
+                     "match": {"resources": {"kinds": ["Pod"]}},
+                     "validate": {"message": "app-3 template frozen",
+                                  "pattern": {"metadata": {"labels": {
+                                      "app": "!app-3"}}}}}]},
+    }
+    fp_before = scanner.state_fingerprint()
+    report = dry_run(candidate, scanner=scanner)
+    quiescent = scanner.state_fingerprint() == fp_before
+    bstack["batcher"].stop()
+
+    slim = {leg: {k: r[k] for k in ("events", "duration_s",
+                                    "achieved_per_s", "latency_ms_p50",
+                                    "latency_ms_p99", "queue_depth_max",
+                                    "denied")}
+            for leg, r in legs.items()}
+    met = (len(digests) == 1 and legs["webhook"]["denied"] > 0
+           and corpus_rows >= 10_000 and quiescent
+           and report["resources_evaluated"] == corpus_rows)
+    return {
+        "policies": len(pols),
+        "trace": tr.stats(),
+        "verdict_digest": next(iter(digests)),
+        "admission_legs": slim,
+        "manifest_trace_digest": manifest["trace"]["digest"],
+        "background_leg": {k: bg[k] for k in (
+            "events", "duration_s", "achieved_per_s", "delta_scans",
+            "rows_evaluated", "cols_evaluated", "violations",
+            "reflector_syncs")},
+        "corpus_rows": corpus_rows,
+        "dryrun": {k: report[k] for k in (
+            "policy", "compile_lane", "resources_evaluated",
+            "newly_failing", "newly_passing", "duration_s")},
+        "dryrun_quiescent": quiescent,
+        "target": "4-leg verdict parity on the full digest; dry-run over "
+                  ">= 10k replayed rows with zero scan-state movement",
+        "met": met,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -1959,7 +2076,8 @@ def main() -> None:
                     ("5_scan_1M", bench_config5),
                     ("6_policy_update_storm", bench_config6),
                     ("7_host_heavy_mix", bench_config7),
-                    ("9_streaming_open_loop", bench_config9)):
+                    ("9_streaming_open_loop", bench_config9),
+                    ("10_trace_replay", bench_config10)):
         if only and name.split("_")[0] not in only:
             continue
         try:

@@ -1,0 +1,187 @@
+"""Workload-plane smoke for CI (deploy/ci_lint.sh).
+
+Proves the replay harness and the rollout dry-run service keep their
+two core promises on every run:
+
+1. cross-leg verdict parity — one small synthesized churn trace plays
+   through the webhook, stream (JSON + ROW), and background legs of a
+   single serving stack; every admission leg must produce the same
+   per-event verdict digest and the background leg's persisted verdict
+   matrix must flag exactly the resources the admission stream denied;
+2. dry-run blast radius with zero live impact — a >=10k-resource
+   corpus is built by replaying a large trace through the background
+   leg (the real watch machinery), then a known-tightening candidate
+   dry-runs against it: the reported newly-failing set must equal an
+   independently computed plant, and the scanner state fingerprint,
+   the verdict matrix bytes, and the admission batcher's result-cache
+   fingerprint must not move;
+3. kill switch — KTPU_DRYRUN=0 must refuse the dry-run (403 on the
+   HTTP surface, DryRunDisabled in-process) while a live admission
+   decision stays byte-identical across the refused attempt.
+
+Exit 0 = all hold, 1 = any divergence.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _policy(name, pattern, message):
+    return {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce",
+                     "background": True, "rules": [{
+                         "name": f"{name}-r0",
+                         "match": {"resources": {"kinds": ["Pod"]}},
+                         "validate": {"message": message,
+                                      "pattern": pattern}}]}}
+
+
+def main() -> int:
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime import obs_http
+    from kyverno_tpu.runtime.webhook import VALIDATING_WEBHOOK_PATH
+    from kyverno_tpu.workload.dryrun import (DryRunDisabled, dry_run,
+                                             set_scan_source)
+    from kyverno_tpu.workload.replay import ReplayDriver, build_stack
+    from kyverno_tpu.workload.trace import synthesize
+
+    docs = [
+        _policy("disallow-latest",
+                {"spec": {"containers": [{"image": "!*:latest"}]}},
+                "latest tag banned"),
+        _policy("require-team-label",
+                {"metadata": {"labels": {"team": "?*"}}},
+                "team label required"),
+    ]
+    pols = [load_policy(d) for d in docs]
+
+    # ---- 1. three-leg verdict parity on a small trace ----------------
+    tr = synthesize(events=90, namespaces=3, name_pool=18,
+                    distinct_bodies=10, seed=11)
+    stack = build_stack(pols)
+    drv = ReplayDriver.from_stack(stack)
+    legs = {leg: drv.run(tr, leg, workers=4)
+            for leg in ("webhook", "stream_json", "stream_row")}
+    digests = {r["verdict_digest"] for r in legs.values()}
+    if len(digests) != 1:
+        print("replay_smoke: admission-leg verdict DIVERGENCE: "
+              f"{ {leg: r['verdict_digest'] for leg, r in legs.items()} }",
+              file=sys.stderr)
+        return 1
+    if legs["webhook"]["denied"] == 0:
+        print("replay_smoke: all-allow trace — parity is vacuous",
+              file=sys.stderr)
+        return 1
+    bg = drv.run(tr, "background")
+    if bg["failing_resources"] != legs["webhook"]["failing_resources"]:
+        print("replay_smoke: background verdict matrix disagrees with "
+              "the admission stream on the failing set", file=sys.stderr)
+        return 1
+
+    # ---- 2. >=10k-row corpus + planted blast radius, quiescent -------
+    big = synthesize(events=13_000, namespaces=6, distinct_bodies=48,
+                     update_fraction=0.12, delete_fraction=0.02, seed=3)
+    bstack = build_stack(pols)
+    bdrv = ReplayDriver.from_stack(bstack)
+    bdrv.run(big, "background")
+    scanner = bstack["scanner"]
+    batcher = bstack["batcher"]
+    corpus = len(scanner._state["keys"])
+    if corpus < 10_000:
+        print(f"replay_smoke: corpus too small ({corpus} rows < 10k)",
+              file=sys.stderr)
+        return 1
+
+    # independent plant: count live resources carrying the app-3 label
+    planted = sorted(
+        "/".join((k[0], k[1], k[2]))
+        for k in scanner._state["keys"]
+        if (scanner._state["resources"][k].get("metadata", {})
+            .get("labels", {}).get("app")) == "app-3")
+    candidate = _policy("freeze-app-3",
+                        {"metadata": {"labels": {"app": "!app-3"}}},
+                        "app-3 template frozen")
+
+    fp_scan = scanner.state_fingerprint()
+    fp_cache = batcher.cache_fingerprint()
+    keys_b, cols_b, mat_b = scanner.verdict_matrix()
+    report = dry_run(candidate, scanner=scanner)
+    got = sorted("/".join((k, n, m)) for k, n, m in
+                 [tuple(r.split("/")) for r in
+                  report["newly_failing_resources"]])
+    if report["newly_failing"] != len(planted) or got != planted:
+        print(f"replay_smoke: blast radius mismatch — reported "
+              f"{report['newly_failing']}, planted {len(planted)}",
+              file=sys.stderr)
+        return 1
+    if report["resources_evaluated"] != corpus:
+        print("replay_smoke: dry-run did not cover the corpus",
+              file=sys.stderr)
+        return 1
+    keys_a, cols_a, mat_a = scanner.verdict_matrix()
+    if (scanner.state_fingerprint() != fp_scan
+            or batcher.cache_fingerprint() != fp_cache
+            or keys_a != keys_b or cols_a != cols_b
+            or mat_a.tobytes() != mat_b.tobytes()):
+        print("replay_smoke: dry-run MOVED live state (fingerprint or "
+              "verdict-matrix drift)", file=sys.stderr)
+        return 1
+
+    # ---- 3. KTPU_DRYRUN=0: refused, live decisions bit-identical -----
+    review = {"apiVersion": "admission.k8s.io/v1",
+              "kind": "AdmissionReview",
+              "request": {"uid": "smoke-probe",
+                          "kind": {"kind": "Pod"},
+                          "namespace": "team-0", "operation": "CREATE",
+                          "object": tr.body_of(tr.events[0])}}
+    before = json.dumps(
+        stack["webhook"].handle(VALIDATING_WEBHOOK_PATH, review),
+        sort_keys=True)
+    os.environ["KTPU_DRYRUN"] = "0"
+    try:
+        try:
+            dry_run(candidate, scanner=scanner)
+            print("replay_smoke: KTPU_DRYRUN=0 did not refuse",
+                  file=sys.stderr)
+            return 1
+        except DryRunDisabled:
+            pass
+        set_scan_source(scanner)
+        status, _, _ = obs_http.handle_obs_post(
+            "/debug/dryrun",
+            json.dumps({"policy": candidate}).encode())
+        if status != 403:
+            print(f"replay_smoke: /debug/dryrun returned {status} "
+                  "while disabled (want 403)", file=sys.stderr)
+            return 1
+    finally:
+        del os.environ["KTPU_DRYRUN"]
+        set_scan_source(None)
+    after = json.dumps(
+        stack["webhook"].handle(VALIDATING_WEBHOOK_PATH, review),
+        sort_keys=True)
+    if before != after:
+        print("replay_smoke: live admission decision drifted across a "
+              "refused dry-run", file=sys.stderr)
+        return 1
+    if scanner.state_fingerprint() != fp_scan:
+        print("replay_smoke: refused dry-run moved scan state",
+              file=sys.stderr)
+        return 1
+
+    stack["batcher"].stop()
+    batcher.stop()
+    print(f"replay_smoke: OK (3-leg parity on {legs['webhook']['events']}"
+          f" events / {legs['webhook']['denied']} denies, corpus "
+          f"{corpus} rows, blast radius {report['newly_failing']} == "
+          f"planted, quiescent fingerprints, kill switch exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -646,6 +646,28 @@ class IncrementalCompiler:
             logger.exception("certification of spliced segments failed "
                              "(admission unaffected)")
 
+    def compile_candidate(self, policy) -> CompiledPolicySet:
+        """Isolated single-policy compile for the dry-run service: the
+        candidate's segment assembles over the *shared* append-only
+        dictionary (so flatten rows memoized against the live population
+        splice in unchanged), but — unlike :meth:`subset` — nothing is
+        stored in the segment cache. A candidate that shares its key
+        with a live policy therefore cannot evict that policy's cached
+        segment or force a recompile at the next refresh; the dictionary
+        only ever appends, which live consumers revalidate by epoch."""
+        key = self._policy_key(policy)
+        rules = _validate_rules(policy)
+        seg_irs = [compile_rule_ir(policy, rule, li)
+                   for li, rule in enumerate(rules)]
+        seg = compile_segment(seg_irs, self.dictionary,
+                              name=f"candidate:{key}")
+        rule_refs = [RuleRef(policy, rule, i)
+                     for i, rule in enumerate(rules)]
+        tensors = assemble_tensors([seg], self.dictionary,
+                                   rule_bucket=self.rule_bucket)
+        return CompiledPolicySet([policy],
+                                 _parts=(rule_refs, seg.rule_irs, tensors))
+
     def subset(self, policies: list) -> CompiledPolicySet:
         """Compiled set over a *subset* of the population, assembled from
         the same dictionary and segment cache. Its tensor set snapshots

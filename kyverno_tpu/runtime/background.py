@@ -117,7 +117,11 @@ class BackgroundScanner:
         Idempotent per scanner."""
         if self._obs is None:
             from .obs_http import ObservabilityServer
+            from ..workload.dryrun import set_scan_source
 
+            # a scanner exposing an obs port is the natural dry-run
+            # corpus: POST /debug/dryrun evaluates against its state
+            set_scan_source(self)
             self._obs = ObservabilityServer(host=host, port=port)
             self._obs.start()
         return self._obs
@@ -518,6 +522,33 @@ class BackgroundScanner:
             self.report_gen.add(*result.responses)
         result.duration_s = time.monotonic() - start
         return result
+
+    def state_fingerprint(self) -> str:
+        """Digest of the persisted scan state: row keys in order, body
+        digests, every verdict column byte-for-byte, pending events and
+        the segment-cache keys of the incremental compiler. A dry-run
+        (isolated candidate compile + copy-resolved evaluation) must
+        leave this identical — the quiescent probe in replay_smoke
+        asserts exactly that."""
+        import hashlib
+        import json as _json
+
+        h = hashlib.sha256()
+        if self._state is not None:
+            state = self._state
+            for key in state["keys"]:
+                h.update(repr(key).encode())
+                body = state["resources"].get(key)
+                h.update(hashlib.sha256(
+                    _json.dumps(body, sort_keys=True,
+                                default=str).encode()).digest())
+            for ck in sorted(state["cols"]):
+                h.update(repr(ck).encode())
+                h.update(np.ascontiguousarray(state["cols"][ck]).tobytes())
+        h.update(str(len(self._events)).encode())
+        if self._inc is not None:
+            h.update(repr(sorted(self._inc._segments)).encode())
+        return h.hexdigest()[:16]
 
     def verdict_matrix(self):
         """(row keys, column keys, matrix) snapshot of the persisted scan

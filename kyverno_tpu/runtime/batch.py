@@ -452,6 +452,23 @@ class AdmissionBatcher:
         with self._lock:
             self._cache_store(key, CLEAN if clean else ATTENTION, row)
 
+    def cache_fingerprint(self) -> str:
+        """Digest of every live decision the batcher holds: result-cache
+        entries (expiry timestamps excluded — they move on their own)
+        and the routing counters. The dry-run quiescent probe compares
+        this before/after a candidate evaluation to prove the service
+        touched no live state."""
+        import hashlib
+
+        h = hashlib.sha256()
+        with self._lock:
+            for key in sorted(self._result_cache, key=repr):
+                entry = self._result_cache[key]
+                h.update(repr((key, entry[1:])).encode())
+            h.update(repr(sorted(self.stats.items())).encode())
+        h.update(str(getattr(self.policy_cache, "generation", 0)).encode())
+        return h.hexdigest()[:16]
+
     # ------------------------------------------------------------ enqueue
 
     def screen(self, ptype, kind: str, namespace: str, resource: dict,

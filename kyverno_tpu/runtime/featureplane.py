@@ -124,6 +124,13 @@ REGISTRY: dict[str, Switch] = {s.name: s for s in (
     _S("KTPU_BENCH_CONFIGS", "bench",
        "bench.py --smoke", "",
        "comma-separated bench config subset to run"),
+    # -- workload plane (trace replay + rollout dry-run)
+    _S("KTPU_REPLAY", "kyverno_tpu.workload.replay",
+       "deploy/replay_smoke.py", "1",
+       "audit-trace replay injection (webhook/stream/background legs)"),
+    _S("KTPU_DRYRUN", "kyverno_tpu.workload.dryrun",
+       "deploy/replay_smoke.py", "1",
+       "policy-rollout dry-run service (POST /debug/dryrun, CLI)"),
 )}
 
 

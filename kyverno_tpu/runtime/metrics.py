@@ -43,6 +43,11 @@ BUCKET_OVERRIDES = {
     "kyverno_stream_request_duration_seconds": (
         0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
         0.5, 1.0, 2.5),
+    # replay latency is measured from the *scheduled* arrival, so the
+    # ladder must cover queue-wait tails well past the per-event cost
+    "kyverno_replay_latency_seconds": (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
 }
 
 
@@ -840,6 +845,56 @@ def record_events(registry: MetricsRegistry, emitted: int = 0,
     if dropped:
         registry.inc_counter("kyverno_events_rate_limited_total", {},
                              float(dropped))
+
+
+# ------------------------------------- workload plane (replay / dry-run)
+
+
+def record_replay_events(registry: MetricsRegistry, leg: str,
+                         n: int = 0, dropped: int = 0) -> None:
+    """Per-leg replay delivery counters (workload/replay.py): events the
+    worker pool processed vs events the bounded queue shed."""
+    if n:
+        registry.inc_counter("kyverno_replay_events_total",
+                             {"leg": leg}, float(n))
+    if dropped:
+        registry.inc_counter("kyverno_replay_events_dropped_total",
+                             {"leg": leg}, float(dropped))
+
+
+def record_replay_latency(registry: MetricsRegistry, leg: str,
+                          seconds: float) -> None:
+    """One replayed event's latency from its *scheduled* arrival —
+    queue wait included, so backlog is visible (open-loop semantics)."""
+    registry.observe("kyverno_replay_latency_seconds", {"leg": leg},
+                     seconds)
+
+
+def record_replay_queue_depth(registry: MetricsRegistry, leg: str,
+                              depth: int) -> None:
+    """Dispatcher-side queue depth sampled at every release."""
+    registry.set_gauge("kyverno_replay_queue_depth", {"leg": leg},
+                       float(depth))
+
+
+def record_dryrun_request(registry: MetricsRegistry, status: str,
+                          seconds: float) -> None:
+    """One dry-run evaluation (workload/dryrun.py): count by outcome +
+    wall time."""
+    registry.inc_counter("kyverno_dryrun_requests_total",
+                         {"status": status})
+    registry.observe("kyverno_dryrun_duration_seconds", {}, seconds)
+
+
+def record_dryrun_blast_radius(registry: MetricsRegistry, policy: str,
+                               newly_failing: int,
+                               newly_passing: int) -> None:
+    """Blast-radius gauges of the most recent dry-run per candidate —
+    what a rollout dashboard plots before flipping enforcement."""
+    registry.set_gauge("kyverno_dryrun_newly_failing",
+                       {"policy": policy}, float(newly_failing))
+    registry.set_gauge("kyverno_dryrun_newly_passing",
+                       {"policy": policy}, float(newly_passing))
 
 
 # ------------------------------------------------------------- profiling

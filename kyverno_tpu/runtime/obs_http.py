@@ -28,6 +28,11 @@ kube-apiserver-facing port also answers scrapes) and a standalone
     On-demand device profiling: paramless GET = capture status plus a
     device-memory snapshot; ``?seconds=N`` starts a bounded
     jax.profiler window capture (409 while one is running).
+``/debug/dryrun``
+    Policy-rollout dry-run (workload/dryrun.py): GET = service status,
+    POST ``{"policy": <ClusterPolicy doc>}`` = blast-radius report for
+    the candidate against the registered scan corpus, with zero live
+    impact. 403 while KTPU_DRYRUN=0.
 """
 
 from __future__ import annotations
@@ -39,10 +44,15 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from . import featureplane
 from . import metrics as metrics_mod
 from . import tracing
 
 _started_at = time.time()
+
+# version stamp on the /debug/* JSON payloads — replay-manifest diffing
+# across PRs keys on it instead of sniffing the layout
+DEBUG_SCHEMA_VERSION = 1
 
 
 def _stream_enabled() -> bool:
@@ -103,6 +113,7 @@ def handle_obs_get(path: str, registry=None):
         except ValueError:
             limit = 0
         payload = metrics_mod.attribution_snapshot(limit=limit)
+        payload["schema_version"] = DEBUG_SCHEMA_VERSION
         payload["attrib_enabled"] = tracing.attrib_enabled()
         reg = registry if registry is not None else metrics_mod.registry()
         payload.update(metrics_mod.lint_findings_snapshot(reg))
@@ -141,12 +152,64 @@ def handle_obs_get(path: str, registry=None):
         if q.get("format", [""])[0] == "chrome":
             payload = rec.chrome_trace(n, slowest=slowest)
         else:
-            payload = {"enabled": tracing.trace_enabled(),
+            payload = {"schema_version": DEBUG_SCHEMA_VERSION,
+                       "enabled": tracing.trace_enabled(),
                        "slowest": slowest,
                        "stats": dict(rec.stats),
                        "traces": rec.export(n, slowest=slowest)}
         return 200, json.dumps(payload).encode(), "application/json"
+    if route == "/debug/dryrun":
+        from ..workload import dryrun as dryrun_mod
+
+        payload = {"schema_version": dryrun_mod.DRYRUN_SCHEMA_VERSION,
+                   "enabled": featureplane.enabled("KTPU_DRYRUN"),
+                   "scan_source": dryrun_mod.scan_source() is not None,
+                   "usage": 'POST {"policy": <ClusterPolicy doc>, '
+                            '"sample_limit": 5}'}
+        return 200, json.dumps(payload).encode(), "application/json"
     return None
+
+
+def handle_obs_post(path: str, body: bytes, registry=None):
+    """Route one POST. Same contract as :func:`handle_obs_get` —
+    ``None`` means "not an observability endpoint". Currently one
+    route: ``/debug/dryrun`` evaluates a candidate policy's blast
+    radius against the registered scan source without touching live
+    decisions (workload/dryrun.py; 403 while KTPU_DRYRUN=0)."""
+    raw = path.split("?", 1)[0].split("#", 1)[0]
+    route = re.sub(r"/{2,}", "/", raw).rstrip("/") or "/"
+    if route != "/debug/dryrun":
+        return None
+    from ..workload import dryrun as dryrun_mod
+
+    try:
+        req = json.loads(body or b"{}")
+    except ValueError:
+        return (400, json.dumps({"error": "body must be JSON"}).encode(),
+                "application/json")
+    doc = req.get("policy") if isinstance(req, dict) else None
+    if not isinstance(doc, dict):
+        return (400, json.dumps(
+            {"error": 'missing "policy" (a ClusterPolicy doc)'}).encode(),
+            "application/json")
+    try:
+        sample_limit = int(req.get("sample_limit", 5))
+    except (TypeError, ValueError):
+        sample_limit = 5
+    try:
+        report = dryrun_mod.dry_run(doc, sample_limit=sample_limit)
+    except dryrun_mod.DryRunDisabled as e:
+        return (403, json.dumps({"error": str(e)}).encode(),
+                "application/json")
+    except ValueError as e:
+        # no registered scan corpus (or an unloadable candidate)
+        return (503, json.dumps({"error": str(e)}).encode(),
+                "application/json")
+    except Exception as e:
+        return (500, json.dumps(
+            {"error": f"{type(e).__name__}: {e}"}).encode(),
+            "application/json")
+    return 200, json.dumps(report).encode(), "application/json"
 
 
 class ObservabilityServer:
@@ -176,8 +239,7 @@ class ObservabilityServer:
             def log_message(self, *args):
                 pass
 
-            def do_GET(self):
-                out = handle_obs_get(self.path, outer.registry)
+            def _reply(self, out):
                 if out is None:
                     out = (404, b"not found", "text/plain")
                 status, body, ctype = out
@@ -186,6 +248,15 @@ class ObservabilityServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(handle_obs_get(self.path, outer.registry))
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                self._reply(handle_obs_post(self.path, body,
+                                            outer.registry))
 
         class Httpd(ThreadingHTTPServer):
             daemon_threads = True

@@ -181,6 +181,19 @@ def encode_json_frame(req_id: int, review: dict,
                           traceparent=traceparent)
 
 
+def decode_verdict_frame(payload: bytes) -> tuple[int, dict]:
+    """(req_id, decoded response) for one server reply frame. F_ERROR
+    raises RuntimeError with the server's message; any other frame type
+    raises ValueError. In-process consumers (the replay driver's stream
+    legs, tests) share this instead of re-implementing the unwrap."""
+    ftype, req_id, body, _ = decode_payload_ex(payload)
+    if ftype == F_VERDICT:
+        return req_id, json.loads(body)
+    if ftype == F_ERROR:
+        raise RuntimeError(body.decode("utf-8", "replace"))
+    raise ValueError(f"unexpected reply frame type {ftype:#x}")
+
+
 # ------------------------------------------------------- client-side prep
 
 

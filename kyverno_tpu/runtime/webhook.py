@@ -1081,6 +1081,19 @@ class WebhookServer:
 
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
+                if self.path.split("?", 1)[0].startswith("/debug/"):
+                    # observability POSTs (/debug/dryrun) are not
+                    # admissions: route them before the AdmissionReview
+                    # parse and keep them out of the admission trace
+                    body = self.rfile.read(length) if length else b""
+                    obs = obs_http.handle_obs_post(self.path, body,
+                                                   server.registry)
+                    if obs is not None:
+                        status, rbody, ctype = obs
+                        self._reply(status, rbody, ctype)
+                    else:
+                        self._reply(404, b"")
+                    return
                 rec = tracing.recorder()
                 trace = rec.start("admission", path=self.path,
                                   transport="http")

@@ -1,0 +1,186 @@
+"""Direct unit tests for runtime/watch (Reflector, WatchHub,
+decode_watch_line) against a scripted in-memory client — the transport
+suite (test_watch.py) covers the HTTP path; these pin the state-machine
+semantics: rv tracking, bookmark handling, 410 re-list, hub fan-out and
+late-subscriber replay."""
+
+import threading
+import time
+
+from kyverno_tpu.runtime.watch import Reflector, WatchHub, decode_watch_line
+
+
+def _obj(name, ns="default", rv="1", kind=None):
+    o = {"metadata": {"name": name, "namespace": ns,
+                      "resourceVersion": rv}}
+    if kind:
+        o["kind"] = kind
+    return o
+
+
+class ScriptedClient:
+    """list_response/watch_stream client: each watch_stream call pops
+    the next script (a list of (type, obj) frames). When more scripts
+    remain the stream closes cleanly after its frames (forcing a
+    reconnect); the last script blocks until stop is set (steady
+    state, no reconnect churn)."""
+
+    def __init__(self, items=None, scripts=None, rv="10"):
+        self.items = items or []
+        self.scripts = list(scripts or [])
+        self.rv = rv
+        self.lists = 0
+        self.watch_calls = 0
+
+    def list_response(self, api_version, kind, namespace=""):
+        self.lists += 1
+        return {"items": [dict(i) for i in self.items],
+                "metadata": {"resourceVersion": self.rv}}
+
+    def watch_stream(self, api_version, kind, namespace="",
+                     resource_version=None, stop=None):
+        self.watch_calls += 1
+        self.last_rv_seen = resource_version
+        script = self.scripts.pop(0) if self.scripts else []
+        for frame in script:
+            yield frame
+        if self.scripts:
+            return      # clean close; the reflector reconnects
+        while stop is not None and not stop.is_set():
+            time.sleep(0.01)
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_reflector_list_primes_and_defaults_gvk():
+    client = ScriptedClient(items=[_obj("a"), _obj("b")])
+    synced = []
+    refl = Reflector(client, "v1", "Pod",
+                     on_sync=lambda items: synced.append(items),
+                     backoff_base_s=0.01)
+    refl.start()
+    assert refl.wait_synced(5.0)
+    refl.stop()
+    assert len(synced) == 1
+    assert [o["metadata"]["name"] for o in synced[0]] == ["a", "b"]
+    # list items omit kind/apiVersion; the reflector restores them
+    assert all(o["kind"] == "Pod" for o in synced[0])
+    assert all(o["apiVersion"] == "v1" for o in synced[0])
+    assert refl.last_resource_version == "10"
+
+
+def test_reflector_events_advance_rv_and_skip_bookmarks():
+    client = ScriptedClient(scripts=[[
+        ("ADDED", _obj("a", rv="11")),
+        ("BOOKMARK", _obj("", rv="12")),
+        ("MODIFIED", _obj("a", rv="13")),
+    ]])
+    events = []
+    refl = Reflector(client, "v1", "Pod",
+                     on_event=lambda t, o: events.append((t, o)),
+                     backoff_base_s=0.01)
+    refl.start()
+    assert _wait(lambda: len(events) == 2)
+    refl.stop()
+    assert [t for t, _ in events] == ["ADDED", "MODIFIED"]
+    # bookmarks checkpoint rv without reaching consumers
+    assert refl.last_resource_version == "13"
+    assert all(o["kind"] == "Pod" for _, o in events)
+
+
+def test_reflector_410_gone_triggers_relist():
+    client = ScriptedClient(scripts=[
+        [("ERROR", {"code": 410})],       # first watch: rv too old
+        [("ADDED", _obj("late", rv="21"))],
+    ])
+    events = []
+    refl = Reflector(client, "v1", "Pod",
+                     on_event=lambda t, o: events.append(t),
+                     backoff_base_s=0.01)
+    refl.start()
+    assert _wait(lambda: client.lists >= 2 and events)
+    refl.stop()
+    assert refl.syncs >= 2
+
+
+def test_reflector_watch_resumes_from_last_rv():
+    client = ScriptedClient(scripts=[
+        [("ADDED", _obj("a", rv="42"))],  # then clean close: reconnect
+        [],
+    ])
+    refl = Reflector(client, "v1", "Pod", backoff_base_s=0.01)
+    refl.start()
+    assert _wait(lambda: client.watch_calls >= 2)
+    refl.stop()
+    # the reconnect resumed from the event's rv, not the list's
+    assert client.last_rv_seen == "42"
+
+
+def test_decode_watch_line():
+    t, o = decode_watch_line(
+        b'{"type":"ADDED","object":{"metadata":{"name":"x"}}}')
+    assert t == "ADDED" and o["metadata"]["name"] == "x"
+    assert decode_watch_line(b"") is None
+    assert decode_watch_line(b"   \n") is None
+    assert decode_watch_line(b"not json") is None
+    t, o = decode_watch_line(b'{"type":"ERROR","object":{"code":410}}')
+    assert t == "ERROR" and o["code"] == 410
+
+
+def test_hub_ensure_is_idempotent_per_gvk():
+    client = ScriptedClient(items=[_obj("a")])
+    hub = WatchHub(client)
+    r1 = hub.ensure("v1", "Pod", on_event=lambda t, o: None)
+    r2 = hub.ensure("v1", "Pod", on_event=lambda t, o: None)
+    other = hub.ensure("v1", "Service")
+    assert r1 is r2
+    assert other is not r1
+    hub.stop()
+
+
+def test_hub_late_subscriber_gets_watch_maintained_state():
+    client = ScriptedClient(items=[_obj("a", rv="1")], scripts=[[
+        ("ADDED", _obj("b", rv="11")),
+        ("DELETED", _obj("a", rv="12")),
+    ]])
+    hub = WatchHub(client)
+    first_events = []
+    refl = hub.ensure("v1", "Pod",
+                      on_event=lambda t, o: first_events.append(t))
+    assert refl.wait_synced(5.0)
+    assert _wait(lambda: len(first_events) == 2)
+
+    # late joiner: replay must reflect list + every event since —
+    # "b" added, "a" deleted — not the stale list
+    late = []
+    hub.ensure("v1", "Pod", on_sync=lambda items: late.append(items))
+    assert _wait(lambda: late)
+    names = sorted(o["metadata"]["name"] for o in late[0])
+    assert names == ["b"]
+    hub.stop()
+
+
+def test_hub_fans_events_to_all_subscribers():
+    release = threading.Event()
+
+    class GatedClient(ScriptedClient):
+        def watch_stream(self, *a, **kw):
+            release.wait(5.0)
+            yield from super().watch_stream(*a, **kw)
+
+    client = GatedClient(scripts=[[("ADDED", _obj("x", rv="2"))]])
+    hub = WatchHub(client)
+    got_a, got_b = [], []
+    hub.ensure("v1", "Pod", on_event=lambda t, o: got_a.append(t))
+    hub.ensure("v1", "Pod", on_event=lambda t, o: got_b.append(t))
+    release.set()
+    assert _wait(lambda: got_a and got_b)
+    hub.stop()
+    assert got_a == ["ADDED"] and got_b == ["ADDED"]

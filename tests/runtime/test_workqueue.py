@@ -1,0 +1,102 @@
+"""Direct unit tests for runtime/workqueue.WorkerQueue (previously only
+exercised indirectly through the audit handler and event generator)."""
+
+import threading
+import time
+
+from kyverno_tpu.runtime.workqueue import WorkerQueue
+
+
+def test_processes_all_items():
+    seen = []
+    lock = threading.Lock()
+
+    def handler(item):
+        with lock:
+            seen.append(item)
+
+    wq = WorkerQueue(handler, workers=4, name="t")
+    wq.run()
+    for i in range(200):
+        assert wq.add(i)
+    wq.drain(timeout=10.0)
+    wq.stop()
+    assert wq.processed == 200
+    assert wq.dropped == 0
+    assert sorted(seen) == list(range(200))
+
+
+def test_bounded_queue_sheds_load():
+    release = threading.Event()
+
+    def handler(item):
+        release.wait(5.0)
+
+    wq = WorkerQueue(handler, workers=1, name="t", max_queued=2)
+    wq.run()
+    # worker grabs the first item and blocks; two fit in the queue
+    results = [wq.add(i) for i in range(10)]
+    dropped_before_release = wq.dropped
+    release.set()
+    wq.drain(timeout=10.0)
+    wq.stop()
+    assert results.count(False) == dropped_before_release
+    assert wq.dropped >= 1
+    assert wq.processed + wq.dropped == 10
+
+
+def test_retry_on_handler_exception():
+    attempts = {}
+    lock = threading.Lock()
+
+    def handler(item):
+        with lock:
+            attempts[item] = attempts.get(item, 0) + 1
+            if attempts[item] < 3:
+                raise RuntimeError("transient")
+
+    wq = WorkerQueue(handler, workers=2, name="t", max_retries=3)
+    wq.run()
+    wq.add("a")
+    wq.drain(timeout=10.0)
+    wq.stop()
+    assert attempts["a"] == 3
+    assert wq.processed == 1
+
+
+def test_retries_exhausted_item_is_not_processed():
+    def handler(item):
+        raise RuntimeError("permanent")
+
+    wq = WorkerQueue(handler, workers=1, name="t", max_retries=2)
+    wq.run()
+    wq.add("x")
+    wq.drain(timeout=10.0)
+    wq.stop()
+    assert wq.processed == 0
+
+
+def test_drain_waits_for_in_flight_work():
+    done = []
+
+    def handler(item):
+        time.sleep(0.15)
+        done.append(item)
+
+    wq = WorkerQueue(handler, workers=1, name="t")
+    wq.run()
+    wq.add(1)
+    time.sleep(0.02)          # let the worker pick it up (queue empty)
+    wq.drain(timeout=5.0)
+    assert done == [1]
+    wq.stop()
+
+
+def test_stop_terminates_workers():
+    wq = WorkerQueue(lambda item: None, workers=3, name="t")
+    wq.run()
+    threads = list(wq._threads)
+    wq.stop()
+    assert wq._threads == []
+    for t in threads:
+        assert not t.is_alive()

@@ -1,0 +1,197 @@
+"""Dry-run service: planted blast radius, quiescence, HTTP surface."""
+
+import json
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.runtime import obs_http
+from kyverno_tpu.runtime.background import BackgroundScanner
+from kyverno_tpu.workload import dryrun as dryrun_mod
+from kyverno_tpu.workload.dryrun import (DRYRUN_SCHEMA_VERSION,
+                                         DryRunDisabled, dry_run,
+                                         set_scan_source)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_scan_source():
+    prev = dryrun_mod.scan_source()
+    yield
+    set_scan_source(prev)
+
+
+def _pod(ns, name, app, tag):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": {"app": app}},
+            "spec": {"containers": [{
+                "name": "main", "image": f"registry.local/{app}:{tag}"}]}}
+
+
+def _baseline_doc():
+    return {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": "no-latest"},
+            "spec": {"validationFailureAction": "enforce",
+                     "background": True, "rules": [{
+                         "name": "no-latest",
+                         "match": {"resources": {"kinds": ["Pod"]}},
+                         "validate": {"message": "latest tag banned",
+                                      "pattern": {"spec": {"containers": [
+                                          {"image": "!*:latest"}]}}}}]}}
+
+
+def _candidate_doc(name="block-app3", pattern=None, message="app-3 banned"):
+    return {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce",
+                     "background": True, "rules": [{
+                         "name": "r0",
+                         "match": {"resources": {"kinds": ["Pod"]}},
+                         "validate": {"message": message,
+                                      "pattern": pattern or {
+                                          "metadata": {"labels": {
+                                              "app": "!app-3"}}}}}]}}
+
+
+# Planted corpus: 3 app-3 pods (2 in ns-a, 1 in ns-b), 2 :latest pods.
+CORPUS = [
+    _pod("ns-a", "p0", "app-0", "v1"),
+    _pod("ns-a", "p1", "app-3", "v1"),
+    _pod("ns-a", "p2", "app-3", "latest"),
+    _pod("ns-a", "p3", "app-1", "v2"),
+    _pod("ns-b", "p4", "app-3", "v1"),
+    _pod("ns-b", "p5", "app-2", "latest"),
+    _pod("ns-b", "p6", "app-0", "v3"),
+]
+
+
+def _scanner():
+    s = BackgroundScanner([load_policy(_baseline_doc())])
+    s.scan(CORPUS)
+    return s
+
+
+def test_planted_blast_radius_counts_and_samples():
+    scanner = _scanner()
+    report = dry_run(_candidate_doc(), scanner=scanner, sample_limit=2)
+    assert report["schema_version"] == DRYRUN_SCHEMA_VERSION
+    assert report["policy"] == "block-app3"
+    assert report["compile_lane"] == "incremental_isolated"
+    assert report["resources_evaluated"] == len(CORPUS)
+    # brand-new policy name: no baseline columns in the matrix
+    assert report["baseline_present"] is False
+    assert report["newly_failing"] == 3
+    assert sorted(report["newly_failing_resources"]) == [
+        "Pod/ns-a/p1", "Pod/ns-a/p2", "Pod/ns-b/p4"]
+    assert report["per_namespace"] == {
+        "ns-a": {"newly_failing": 2, "newly_passing": 0},
+        "ns-b": {"newly_failing": 1, "newly_passing": 0}}
+    assert len(report["samples"]) == 2
+    assert all(s["rule"] == "r0" and "app-3" in s["message"]
+               for s in report["samples"])
+    dec = report["device_decidability"]
+    assert dec["rules"] == 1
+    assert dec["device_decidable"] + dec["host_only"] == dec["rules"]
+
+
+def test_loosened_same_name_policy_reports_newly_passing():
+    scanner = _scanner()
+    # the live matrix FAILs the two :latest pods for "no-latest";
+    # a loosened candidate under the same name flips them to passing
+    loose = _candidate_doc(name="no-latest",
+                           pattern={"spec": {"containers": [
+                               {"image": "*"}]}},
+                           message="anything goes")
+    report = dry_run(loose, scanner=scanner)
+    assert report["baseline_present"] is True
+    assert report["newly_failing"] == 0
+    assert report["newly_passing"] == 2
+    assert sorted(report["newly_passing_resources"]) == [
+        "Pod/ns-a/p2", "Pod/ns-b/p5"]
+    assert report["still_failing"] == 0
+
+
+def test_dry_run_leaves_scan_state_untouched():
+    scanner = _scanner()
+    before_fp = scanner.state_fingerprint()
+    keys_b, cols_b, mat_b = scanner.verdict_matrix()
+    dry_run(_candidate_doc(), scanner=scanner)
+    dry_run(_candidate_doc(name="no-latest"), scanner=scanner)
+    assert scanner.state_fingerprint() == before_fp
+    keys_a, cols_a, mat_a = scanner.verdict_matrix()
+    assert keys_a == keys_b and cols_a == cols_b
+    assert mat_a.tobytes() == mat_b.tobytes()
+    # the isolated candidate segment must not join the live cache
+    assert not any(str(k).startswith("candidate:")
+                   for k in scanner._inc._segments)
+
+
+def test_gate_blocks_dry_run(monkeypatch):
+    scanner = _scanner()
+    monkeypatch.setenv("KTPU_DRYRUN", "0")
+    with pytest.raises(DryRunDisabled):
+        dry_run(_candidate_doc(), scanner=scanner)
+
+
+def test_no_corpus_raises_value_error():
+    with pytest.raises(ValueError, match="no scan corpus"):
+        dry_run(_candidate_doc(), scanner=BackgroundScanner([]))
+
+
+def test_explicit_resources_override_corpus():
+    report = dry_run(_candidate_doc(), scanner=None,
+                     resources=[_pod("x", "only", "app-3", "v1")])
+    assert report["compile_lane"] == "one_shot"
+    assert report["resources_evaluated"] == 1
+    assert report["newly_failing"] == 1
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+def _post(body):
+    return obs_http.handle_obs_post("/debug/dryrun", body)
+
+
+def test_obs_post_full_report_via_registered_source():
+    set_scan_source(_scanner())
+    status, body, ctype = _post(json.dumps(
+        {"policy": _candidate_doc(), "sample_limit": 1}).encode())
+    assert status == 200 and ctype == "application/json"
+    report = json.loads(body)
+    assert report["newly_failing"] == 3
+    assert len(report["samples"]) == 1
+
+
+def test_obs_post_error_paths(monkeypatch):
+    assert _post(b"{not json")[0] == 400
+    assert _post(json.dumps({"nope": 1}).encode())[0] == 400
+    # no scan source registered -> 503 service unavailable
+    set_scan_source(None)
+    status, body, _ = _post(json.dumps(
+        {"policy": _candidate_doc()}).encode())
+    assert status == 503 and b"corpus" in body
+    monkeypatch.setenv("KTPU_DRYRUN", "0")
+    assert _post(json.dumps({"policy": _candidate_doc()}).encode())[0] \
+        == 403
+    # non-dryrun POST paths fall through to the caller's routes
+    assert obs_http.handle_obs_post("/mutate", b"{}") is None
+
+
+def test_obs_get_dryrun_status():
+    set_scan_source(_scanner())
+    status, body, _ = obs_http.handle_obs_get("/debug/dryrun")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["schema_version"] == DRYRUN_SCHEMA_VERSION
+    assert payload["enabled"] is True
+    assert payload["scan_source"] is True
+    assert "POST" in payload["usage"]
+
+
+def test_debug_payloads_carry_schema_version():
+    for path in ("/debug/traces", "/debug/policies"):
+        status, body, _ = obs_http.handle_obs_get(path)
+        assert status == 200
+        assert json.loads(body)["schema_version"] == \
+            obs_http.DEBUG_SCHEMA_VERSION

@@ -1,0 +1,144 @@
+"""Replay driver: cross-leg verdict parity, gating, manifests."""
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.workload.replay import (MANIFEST_SCHEMA_VERSION,
+                                         ReplayDisabled, ReplayDriver,
+                                         build_stack, diff_manifests,
+                                         run_manifest)
+from kyverno_tpu.workload.trace import synthesize
+
+
+def _policy_docs():
+    return [
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "disallow-latest"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "no-latest",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "latest tag banned",
+                                   "pattern": {"spec": {"containers": [
+                                       {"image": "!*:latest"}]}}}}]}},
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "require-team"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "has-team",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "team label required",
+                                   "pattern": {"metadata": {"labels": {
+                                       "team": "?*"}}}}}]}},
+    ]
+
+
+@pytest.fixture(scope="module")
+def stack():
+    return build_stack([load_policy(d) for d in _policy_docs()])
+
+
+@pytest.fixture(scope="module")
+def trace():
+    # mixed verdicts by construction: every 4th body template ships a
+    # :latest image, so parity is checked on a non-trivial stream
+    return synthesize(events=48, namespaces=3, name_pool=10,
+                      distinct_bodies=8, seed=13)
+
+
+def test_admission_leg_parity_and_capture(stack, trace):
+    drv = ReplayDriver.from_stack(stack)
+    results = {leg: drv.run(trace, leg, workers=4)
+               for leg in ("webhook", "stream_json", "stream_row",
+                           "stream_block")}
+    digests = {r["verdict_digest"] for r in results.values()}
+    assert len(digests) == 1, results
+    web = results["webhook"]
+    assert web["denied"] > 0                # mixed stream, not vacuous
+    assert web["processed"] == web["events"] == len(
+        [e for e in trace.events if e.op != "POLICY"])
+    assert web["dropped"] == 0 and not web["errors"]
+    assert web["latency_ms_p99"] >= web["latency_ms_p50"] >= 0
+    assert web["queue_depth_max"] >= 1      # open loop: backlog visible
+    assert results["stream_row"]["failing_resources"] == \
+        web["failing_resources"]
+
+
+def test_background_leg_matches_admission_failures(stack, trace):
+    drv = ReplayDriver.from_stack(stack)
+    web = drv.run(trace, "webhook", workers=4)
+    bg = drv.run(trace, "background")
+    assert bg["processed"] == bg["events"]
+    assert bg["delta_scans"] >= 1
+    assert bg["reflector_syncs"] >= 1
+    # the persisted verdict matrix and the per-event admission stream
+    # must agree on which live resources violate
+    assert bg["failing_resources"] == web["failing_resources"]
+    assert bg["violations"] > 0
+
+
+def test_background_leg_policy_churn_runs_delta_scans():
+    pols = [load_policy(_policy_docs()[0])]
+    stack = build_stack(pols)
+    churn_doc = _policy_docs()[1]
+    tr = synthesize(events=60, namespaces=2, name_pool=8,
+                    distinct_bodies=6, policy_docs=[churn_doc],
+                    policy_churn_every=20, seed=21)
+    assert any(e.op == "POLICY" for e in tr.events)
+    drv = ReplayDriver.from_stack(stack)
+    bg = drv.run(tr, "background")
+    assert bg["delta_scans"] >= 2           # per POLICY event + final
+    # the churned-in policy's columns joined the matrix
+    _, cols, _ = stack["scanner"].verdict_matrix()
+    assert any(c[0] == churn_doc["metadata"]["name"] for c in cols)
+
+
+def test_arrival_faithful_mode_honors_trace_clock(stack):
+    tr = synthesize(events=12, namespaces=2, base_rate=60.0, seed=8)
+    drv = ReplayDriver.from_stack(stack)
+    out = drv.run(tr, "stream_json", speed=1.0, workers=4)
+    assert out["processed"] == out["events"]
+    # dispatcher can't finish before the last scheduled arrival
+    assert out["duration_s"] >= tr.events[-1].ts * 0.9
+
+
+def test_replay_gate_blocks_injection(stack, trace, monkeypatch):
+    monkeypatch.setenv("KTPU_REPLAY", "0")
+    drv = ReplayDriver.from_stack(stack)
+    with pytest.raises(ReplayDisabled):
+        drv.run(trace, "webhook")
+    with pytest.raises(ReplayDisabled):
+        drv.run(trace, "background")
+
+
+def test_unknown_leg_rejected(stack, trace):
+    drv = ReplayDriver.from_stack(stack)
+    with pytest.raises(ValueError, match="leg"):
+        drv.run(trace, "carrier-pigeon")
+
+
+def test_run_manifest_and_diff(stack, trace, tmp_path):
+    import json
+
+    drv = ReplayDriver.from_stack(stack)
+    a = drv.run(trace, "stream_json", workers=4)
+    b = drv.run(trace, "stream_json", workers=4)
+    path = str(tmp_path / "run.json")
+    ma = run_manifest(trace, [a], path=path, note="A")
+    mb = run_manifest(trace, [b], note="B")
+    assert ma["schema_version"] == MANIFEST_SCHEMA_VERSION
+    assert ma["trace"]["digest"] == trace.content_digest()
+    # per-event verdict maps stay out of the persisted manifest
+    assert "verdicts" not in ma["legs"]["stream_json"]
+    on_disk = json.load(open(path))
+    assert on_disk["legs"]["stream_json"]["verdict_digest"] == \
+        a["verdict_digest"]
+
+    diff = diff_manifests(ma, mb)
+    assert diff["same_trace"] is True
+    assert diff["legs"]["stream_json"]["verdict_parity"] is True
+    assert "latency_ms_p99_delta" in diff["legs"]["stream_json"]
+
+    bad = dict(mb, schema_version=MANIFEST_SCHEMA_VERSION + 1)
+    with pytest.raises(ValueError, match="schema_version"):
+        diff_manifests(ma, bad)
