@@ -2058,6 +2058,51 @@ def bench_config10(jax):
     }
 
 
+def bench_config11(jax):
+    """Chaos/storm suite (round 12): the SLO loop closed under faults.
+    Four scenarios — arrival storm, policy-churn storm, oracle-pool
+    brownout, replica/scanner loss — each run as baseline -> fault
+    episode -> recovery against a fresh serving stack with the
+    degradation ladder armed (tight budgets so seconds-long faults trip
+    the multi-window watchdog). Every scenario must show the controller
+    degrading, acting, and recovering on its own: episode p99 inside
+    the derived degraded budget, the degraded gauge back at 0 without a
+    restart, actions logged with enter/exit timestamps in the run
+    manifest, any verdict drift covered by a reported shed set, and the
+    post-recovery digest bit-identical to the undisturbed baseline. A
+    fifth leg re-runs the arrival storm with KTPU_SLO_ACTIONS=0 and
+    asserts annotate-only behavior: no actions engage and even the
+    episode digest matches. Acceptance: all four scenarios green plus
+    the kill-switch parity leg."""
+    from kyverno_tpu.workload.chaos import run_scenario, run_suite
+
+    suite = run_suite(events=40, delay_s=0.4, workers=6)
+    parity = run_scenario("arrival_storm", events=40, delay_s=0.4,
+                          workers=6, actions="0")
+
+    scen = {}
+    for name, r in suite["scenarios"].items():
+        scen[name] = {
+            "ok": r["ok"],
+            "checks": r["checks"],
+            "p99_ms": {"baseline": r["baseline_p99_ms"],
+                       "episode": r["episode_p99_ms"],
+                       "recovery": r["recovery_p99_ms"]},
+            "p99_budget_ms": r["p99_budget_ms"],
+            "shed": r["shed"],
+            "actions": sorted({e["action"] for e in r["action_log"]}),
+        }
+    met = suite["ok"] and parity["ok"]
+    return {
+        "scenarios": scen,
+        "killswitch_parity": {"ok": parity["ok"],
+                              "checks": parity["checks"]},
+        "target": "4 chaos scenarios degrade/act/recover with digest "
+                  "parity; KTPU_SLO_ACTIONS=0 restores annotate-only",
+        "met": met,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -2077,7 +2122,8 @@ def main() -> None:
                     ("6_policy_update_storm", bench_config6),
                     ("7_host_heavy_mix", bench_config7),
                     ("9_streaming_open_loop", bench_config9),
-                    ("10_trace_replay", bench_config10)):
+                    ("10_trace_replay", bench_config10),
+                    ("11_chaos_storm", bench_config11)):
         if only and name.split("_")[0] not in only:
             continue
         try:

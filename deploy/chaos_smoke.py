@@ -1,0 +1,70 @@
+"""Degradation-loop smoke for CI (deploy/ci_lint.sh).
+
+Proves the closed SLO loop (runtime/sloactions.py + workload/chaos.py)
+keeps its two core promises on every run, with a fault small enough for
+a CI lane:
+
+1. degrade -> act -> recover — a short oracle-pool brownout trips the
+   multi-window watchdog; the degradation controller must engage at
+   least one ladder action, log it with enter/exit timestamps into the
+   run manifest, and then stand everything down on its own: degraded
+   gauge back at 0 without a restart, post-recovery verdict digest
+   bit-identical to the undisturbed baseline, any episode drift covered
+   by an explicitly reported shed set, and the state-seconds counter
+   accounting both states;
+2. kill switch — KTPU_SLO_ACTIONS=0 under the same fault must restore
+   annotate-only behavior exactly: zero actions engage and even the
+   episode digest matches the baseline byte-for-byte.
+
+Exit 0 = all hold, 1 = any divergence.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from kyverno_tpu.workload.chaos import run_scenario
+
+    failures = []
+
+    # -- leg 1: the loop closes under a short brownout ----------------
+    rep = run_scenario("oracle_brownout", events=24, delay_s=0.35,
+                       workers=6, actions="1")
+    for check, ok in rep["checks"].items():
+        if not ok:
+            failures.append(f"oracle_brownout: check {check} failed")
+    if not rep["action_log"]:
+        failures.append("oracle_brownout: no actions logged")
+    for entry in rep["action_log"]:
+        if "t" not in entry or entry["event"] not in ("enter", "exit"):
+            failures.append(f"oracle_brownout: malformed log {entry}")
+    slo = rep["manifest"].get("slo") or {}
+    if not slo.get("action_log"):
+        failures.append("oracle_brownout: manifest missing slo action log")
+
+    # -- leg 2: KTPU_SLO_ACTIONS=0 restores annotate-only -------------
+    par = run_scenario("oracle_brownout", events=24, delay_s=0.35,
+                       workers=6, actions="0")
+    for check in ("no_actions_engaged", "episode_digest_matches",
+                  "recovery_digest_matches", "degraded_seen"):
+        if not par["checks"].get(check):
+            failures.append(f"killswitch: check {check} failed")
+
+    print(json.dumps({
+        "brownout": {"ok": rep["ok"], "checks": rep["checks"],
+                     "shed": rep["shed"],
+                     "actions": sorted({e["action"]
+                                        for e in rep["action_log"]})},
+        "killswitch": {"ok": par["ok"], "checks": par["checks"]},
+        "failures": failures,
+    }, indent=1))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,9 @@ JAX_PLATFORMS=cpu python deploy/obs_smoke.py || rc=1
 echo "== replay smoke (3-leg trace parity, 10k dry-run blast radius, quiescence)"
 JAX_PLATFORMS=cpu python deploy/replay_smoke.py || rc=1
 
+echo "== chaos smoke (brownout degrade->act->recover, KTPU_SLO_ACTIONS=0 parity)"
+JAX_PLATFORMS=cpu python deploy/chaos_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

@@ -60,6 +60,29 @@ def stream_enabled() -> bool:
     return featureplane.enabled("KTPU_STREAM")
 
 
+def _slo_geometry_active() -> bool:
+    """Whether the SLO degradation controller's latency-optimized
+    geometry profile is engaged (runtime/sloactions.py). False whenever
+    the actions plane is off — the healthy geometry is the default."""
+    try:
+        from . import sloactions
+
+        return sloactions.geometry_active()
+    except Exception:
+        return False
+
+
+def _slo_window_scale() -> float:
+    """Coalescing-window multiplier under the geometry profile (1.0
+    healthy)."""
+    try:
+        from . import sloactions
+
+        return sloactions.window_scale()
+    except Exception:
+        return 1.0
+
+
 def ttl_store(cache: dict, key, ttl_s: float, value: tuple,
               max_size: int = 4096) -> None:
     """Insert ``(expiry, *value)`` with the shared eviction policy:
@@ -300,7 +323,7 @@ class AdmissionBatcher:
         if deadline_free:
             return cpu_won
         device_latency = (self._dispatch_cost * (1 + self._pending_flushes)
-                          + self.window_s)
+                          + self._window())
         lat_ok = device_latency < min(oracle_drain, SCREEN_DEADLINE_S)
         return cpu_won and lat_ok
 
@@ -310,17 +333,25 @@ class AdmissionBatcher:
     # 4/8 rows each hit a cold XLA bucket and fall back to the oracle
     PAD_FLOOR = 16
 
+    def _window(self) -> float:
+        """Effective coalescing window: the configured window scaled
+        down by the SLO geometry profile while degraded (1x healthy)."""
+        return self.window_s * _slo_window_scale()
+
     @classmethod
-    def _pad_admission(cls, batch):
-        """Power-of-two bucket padding with the admission batch floor."""
+    def _pad_admission(cls, batch, floor: int | None = None):
+        """Power-of-two bucket padding with the admission batch floor
+        (``floor`` overrides it — the SLO geometry profile passes a
+        smaller one while degraded; padding never touches verdicts)."""
         from ..models.flatten import pad_packed, pad_to_buckets_packed
         from dataclasses import replace
 
+        pad_floor = cls.PAD_FLOOR if floor is None else floor
         padded, n0 = pad_to_buckets_packed(batch)
-        if padded.cells.shape[0] < cls.PAD_FLOOR:
+        if padded.cells.shape[0] < pad_floor:
             cells, bmeta, _ = pad_packed(
-                padded.cells, padded.bmeta, cls.PAD_FLOOR)
-            padded = replace(padded, n=cls.PAD_FLOOR, cells=cells,
+                padded.cells, padded.bmeta, pad_floor)
+            padded = replace(padded, n=pad_floor, cells=cells,
                              bmeta=bmeta)
         return padded, n0
 
@@ -584,7 +615,7 @@ class AdmissionBatcher:
             if adaptive and not deadline_free:
                 timeout_s = min(timeout_s,
                                 max(0.05, 4 * self._dispatch_cost
-                                    + self.window_s)
+                                    + self._window())
                                 * (1 + self._pending_flushes))
         wait_start = time.monotonic()
         wait_pc = time.perf_counter()
@@ -741,7 +772,7 @@ class AdmissionBatcher:
             if adaptive and not deadline_free:
                 timeout_s = min(timeout_s,
                                 max(0.05, 4 * self._dispatch_cost
-                                    + self.window_s)
+                                    + self._window())
                                 * (1 + self._pending_flushes))
         wait_start = time.monotonic()
         wait_pc = time.perf_counter()
@@ -923,7 +954,7 @@ class AdmissionBatcher:
             # about has joined (queued >= in-flight) or the batch is full
             # — at low depth there is nothing left to wait for, and the
             # full 4ms window would be pure added latency
-            deadline = time.monotonic() + self.window_s
+            deadline = time.monotonic() + self._window()
             with self._lock:
                 while not self._stopped:
                     queued = sum(len(b.items)
@@ -1098,8 +1129,15 @@ class AdmissionBatcher:
                                else "kill_switch"))
             v_used = int(raw.dictv.shape[0])
             # bucket the batch shape (pow2 + admission floor) so XLA
-            # compiles once per bucket, not once per admission batch
-            batch, _ = self._pad_admission(raw)
+            # compiles once per bucket, not once per admission batch;
+            # the SLO geometry profile shrinks the floor while degraded
+            try:
+                from . import sloactions
+
+                floor = sloactions.effective_pad_floor(self.PAD_FLOOR)
+            except Exception:
+                floor = self.PAD_FLOOR
+            batch, _ = self._pad_admission(raw, floor=floor)
             if (self.continuous and stream_enabled() and not is_probe
                     and flush_key is not None):
                 # continuous batches keep string-table headroom (>= 25%
@@ -1134,7 +1172,11 @@ class AdmissionBatcher:
             # the window semantics bit for bit.
             if (self.continuous and stream_enabled() and not is_probe
                     and not cold and flush_key is not None
-                    and batch.n > len(items)):
+                    and batch.n > len(items)
+                    and not _slo_geometry_active()):
+                # geometry action suspends late-join grafting: while
+                # degraded the profile trades fill for latency, and a
+                # graft extends exactly the flush we want out the door
                 late_items: list = []
                 with self._lock:
                     lb = self._buckets.get(flush_key)
@@ -1314,12 +1356,16 @@ class AdmissionBatcher:
                     if base_spans is not None:
                         fut.ktpu_flush_spans = base_spans + [sp]
                     fut.set_result((CLEAN if clean else ATTENTION, row, True))
-            # SLO load-shed annotation (annotate-only this PR): a
-            # degraded fleet stamps the flush trace + a stat counter;
-            # verdicts and routing are untouched by construction
+            # SLO load-shed annotation: a degraded fleet stamps the
+            # flush trace + a stat counter; verdicts are untouched by
+            # construction. The controller tick rides along so flush
+            # traffic keeps the degradation state machine current (the
+            # state-seconds counter accounts idle stretches separately).
             try:
+                from . import sloactions
                 from .slo import watchdog
 
+                sloactions.controller().maybe_tick()
                 ann = watchdog().annotation(max_age_s=1.0)
                 if ann is not None:
                     if ft is not None:

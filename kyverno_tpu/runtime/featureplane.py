@@ -131,6 +131,59 @@ REGISTRY: dict[str, Switch] = {s.name: s for s in (
     _S("KTPU_DRYRUN", "kyverno_tpu.workload.dryrun",
        "deploy/replay_smoke.py", "1",
        "policy-rollout dry-run service (POST /debug/dryrun, CLI)"),
+    # -- SLO degradation plane (closed-loop actions; annotate-only when
+    #    the master switch is off)
+    _S("KTPU_SLO_ACTIONS", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "0",
+       "master switch for closed-loop SLO degradation actions"),
+    _S("KTPU_SLO_SHED", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "shed low-severity enforce policies while degraded"),
+    _S("KTPU_SLO_SHED_MAX", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "max policies in the shed set"),
+    _S("KTPU_SLO_GEOMETRY", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "latency-optimized batcher geometry profile while degraded"),
+    _S("KTPU_SLO_WINDOW_FACTOR", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "0.25",
+       "coalescing/late-join window multiplier under the geometry action"),
+    _S("KTPU_SLO_PAD_FLOOR", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "8",
+       "admission pad floor under the geometry action"),
+    _S("KTPU_SLO_HOSTBOUND", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "bound host-lane fan-out + guard OraclePool submissions"),
+    _S("KTPU_SLO_FANOUT_MAX", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "2",
+       "host-lane fan-out cap while the hostbound action is engaged"),
+    _S("KTPU_SLO_POOL_TIMEOUT_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "0.5",
+       "OraclePool submission timeout while degraded"),
+    _S("KTPU_SLO_POOL_RETRIES", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "bounded retries for a missed guarded pool submission"),
+    _S("KTPU_SLO_BREAKER_THRESHOLD", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "3",
+       "consecutive pool failures before the circuit opens"),
+    _S("KTPU_SLO_BREAKER_COOLDOWN_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "5.0",
+       "open-circuit cooldown before a half-open probe"),
+    _S("KTPU_SLO_SCALE_HINTS", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1",
+       "emit replica scale hints on /healthz while degraded"),
+    _S("KTPU_SLO_DEGRADE_AFTER_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "0.5",
+       "sustained degraded signal before the controller degrades"),
+    _S("KTPU_SLO_RECOVER_AFTER_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "3.0",
+       "sustained healthy signal before the controller recovers"),
+    _S("KTPU_SLO_MIN_DWELL_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "1.0",
+       "minimum dwell in either state (flap suppression)"),
+    _S("KTPU_SLO_TICK_S", "kyverno_tpu.runtime.sloactions",
+       "deploy/chaos_smoke.py", "0.25",
+       "controller tick period / rate limit for maybe_tick"),
 )}
 
 

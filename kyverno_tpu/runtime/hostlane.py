@@ -294,17 +294,31 @@ class HostLaneResolver:
         parent = tracing.current()
         if fanout_enabled() and len(items) > 1:
             ex = self.executor()
-            futs = [(b, ex.submit(self.resolve_resource, cps,
-                                  resources[b], rows, ctx(b), parent))
-                    for b, rows in items]
+            # SLO hostbound action (runtime/sloactions.py): while
+            # degraded, at most ``bound`` resolutions are in flight at
+            # once — submission stays in order and scatter still runs
+            # on the calling thread, so results are byte-identical to
+            # the unbounded fan-out; only the concurrency shrinks
+            from . import sloactions
+
+            bound = sloactions.fanout_bound()
             with self._lock:
                 self.stats["fanout_batches"] += 1
-            for b, fut in futs:
-                try:
-                    oracle = fut.result()
-                except Exception:
-                    continue
-                resolved += _scatter(verdicts, b, oracle, messages_out)
+                if bound is not None:
+                    self.stats["fanout_bounded_batches"] = (
+                        self.stats.get("fanout_bounded_batches", 0) + 1)
+            chunk = bound if bound is not None else len(items)
+            for start in range(0, len(items), max(1, chunk)):
+                futs = [(b, ex.submit(self.resolve_resource, cps,
+                                      resources[b], rows, ctx(b), parent))
+                        for b, rows in items[start:start + max(1, chunk)]]
+                for b, fut in futs:
+                    try:
+                        oracle = fut.result()
+                    except Exception:
+                        continue
+                    resolved += _scatter(verdicts, b, oracle,
+                                         messages_out)
         else:
             for b, rows in items:
                 oracle = self.resolve_resource(cps, resources[b], rows,
@@ -415,7 +429,16 @@ class HostLaneResolver:
             if id(policy) not in live_ids or not _policy_pure(policy):
                 return None
             policies[policy.name] = policy
-        results = pool.evaluate_payload(list(policies), resource, context)
+        # guarded submission (runtime/sloactions.py): timeout/retry and
+        # circuit breaking while the SLO actions plane is live; a plain
+        # default-timeout call when KTPU_SLO_ACTIONS=0
+        from . import sloactions
+
+        names = list(policies)
+        results = sloactions.pool_evaluate(
+            pool, gen,
+            lambda timeout_s: pool.evaluate_payload(
+                names, resource, context, timeout_s=timeout_s))
         if results is None:
             return None
         rows = {(pname, rname): (status, msg)

@@ -823,6 +823,38 @@ def record_slo_gauges(registry: MetricsRegistry, p99_short: float,
     registry.set_gauge("kyverno_slo_budget_seconds", {}, budget_s)
 
 
+def record_slo_state_seconds(registry: MetricsRegistry, state: str,
+                             seconds: float) -> None:
+    """Wall time the degradation controller spent in ``state``
+    (runtime/sloactions.py ticks this) — the fix for degraded stretches
+    with an empty flush queue leaving no evidence: the counter moves on
+    every controller tick, not only when a flush fires."""
+    if seconds > 0:
+        registry.inc_counter("kyverno_slo_state_seconds_total",
+                             {"state": state}, float(seconds))
+
+
+def record_slo_action_transition(registry: MetricsRegistry, action: str,
+                                 direction: str) -> None:
+    """One degradation-action engagement edge (``enter`` | ``exit``)."""
+    registry.inc_counter("kyverno_slo_action_transitions_total",
+                         {"action": action, "direction": direction})
+
+
+def record_slo_shed_size(registry: MetricsRegistry, n: int) -> None:
+    """Current size of the explicit shed set (0 when healthy)."""
+    registry.set_gauge("kyverno_slo_shed_policies", {}, float(n))
+
+
+def record_queue_shed(registry: MetricsRegistry, queue: str,
+                      reason: str) -> None:
+    """One bounded-queue shed, tagged with why (``slo`` =
+    controller-driven, ``full`` = overflow) so dashboards can tell
+    deliberate degradation from capacity loss."""
+    registry.inc_counter("kyverno_queue_sheds_total",
+                         {"queue": queue, "reason": reason})
+
+
 # ------------------------------------- reports / events (reference ports)
 
 

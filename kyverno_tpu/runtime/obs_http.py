@@ -86,9 +86,20 @@ def handle_obs_get(path: str, registry=None):
         rec = tracing.recorder()
         rec.feed_metrics()
         reg = registry if registry is not None else metrics_mod.registry()
+        from . import sloactions
         from .slo import watchdog
 
         slo = watchdog().snapshot()
+        # degradation controller: a scrape doubles as a tick so the
+        # state machine (and the state-seconds counter) advances even
+        # on an idle replica; report() carries the action ladder, the
+        # explicit shed set, and the replica scale hint
+        try:
+            ctl = sloactions.controller()
+            ctl.maybe_tick()
+            slo_actions = ctl.report()
+        except Exception:
+            slo_actions = {"enabled": False, "state": "unknown"}
         body = json.dumps({
             "status": "degraded" if slo.get("degraded") else "ok",
             "uptime_s": round(time.time() - _started_at, 3),
@@ -104,6 +115,7 @@ def handle_obs_get(path: str, registry=None):
                 "continuous": _stream_enabled(),
             },
             "slo": slo,
+            "slo_actions": slo_actions,
         }).encode()
         return 200, body, "application/json"
     if route == "/debug/policies":

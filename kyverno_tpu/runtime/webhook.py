@@ -72,8 +72,9 @@ class AuditHandler(WorkerQueue):
     """validate_audit.go:44 AuditHandler: a rate-limited queue re-running
     audit validation off the hot path (10 workers, max 3 retries)."""
 
-    def __init__(self, handler, workers: int = 10):
-        super().__init__(handler, workers, name="audit", max_retries=3)
+    def __init__(self, handler, workers: int = 10, shed_cb=None):
+        super().__init__(handler, workers, name="audit", max_retries=3,
+                         shed_cb=shed_cb)
 
 
 class WebhookServer:
@@ -108,7 +109,16 @@ class WebhookServer:
         self.resource_cache = (ResourceCache(client)
                                if client is not None else None)
         self.registry = registry or metrics_mod.registry()
-        self.audit_handler = AuditHandler(self._process_audit)
+        # SLO degradation controller (runtime/sloactions.py): policy
+        # source for the shed action; the audit queue sheds (reason
+        # "slo") while the shed action is engaged — deliberate audit
+        # backlog drop is exactly what degraded mode buys
+        from . import sloactions
+
+        sloactions.controller().attach(self.policy_cache)
+        self.audit_handler = AuditHandler(
+            self._process_audit,
+            shed_cb=lambda: sloactions.controller().action_active("shed"))
         self.last_request_time = time.time()
         # decision cache: keyed/TTL'd by the admission batcher's rules
         # (policy generation + resource + requester digest)
@@ -188,11 +198,14 @@ class WebhookServer:
         metrics_mod.record_admission_request(
             self.registry, operation, kind, out["response"]["allowed"])
         # SLO watchdog feed: one sample per finished review (lock-free
-        # append; pure observation — KTPU_SLO=0 makes it a no-op)
+        # append; pure observation — KTPU_SLO=0 makes it a no-op). The
+        # degradation controller tick rides the same hook, rate-limited.
         try:
+            from . import sloactions
             from .slo import watchdog
 
             watchdog().observe(elapsed)
+            sloactions.controller().maybe_tick()
         except Exception:
             pass
         return out
@@ -558,6 +571,24 @@ class WebhookServer:
 
         enforce = self.policy_cache.get_policies(
             PolicyType.VALIDATE_ENFORCE, kind, namespace)
+        # SLO shed action (runtime/sloactions.py): policies in the
+        # explicit, reported shed set drop out of the deny path for the
+        # duration of the degraded episode. Decision caching is
+        # suspended whenever the set is non-empty so a degraded-era
+        # verdict can never leak into the healthy steady state.
+        shed_names: frozenset = frozenset()
+        try:
+            from . import sloactions
+
+            shed_names = sloactions.controller().shed_active_names()
+        except Exception:
+            shed_names = frozenset()
+        if shed_names:
+            kept = [p for p in enforce if p.name not in shed_names]
+            if len(kept) != len(enforce):
+                enforce = kept
+                self.registry.inc_counter(
+                    "kyverno_slo_shed_decisions_total", {})
         blocked_msgs: list[str] = []
         metric_rows: list[tuple] = []
 
@@ -578,7 +609,7 @@ class WebhookServer:
         # run below. Cluster-state context staleness is bounded by the
         # TTL, the same window an informer lookup has.
         decision_key = None
-        if enforce and self.admission_batcher is not None:
+        if enforce and not shed_names and self.admission_batcher is not None:
             decision_key = self.admission_batcher.decision_key(
                 PolicyType.VALIDATE_ENFORCE, kind, namespace, resource,
                 env=screen_env)
@@ -630,7 +661,12 @@ class WebhookServer:
                 self.admission_batcher.stats["device_decided"] = (
                     self.admission_batcher.stats.get("device_decided", 0) + 1)
             elif status == batch_mod.ATTENTION and row:
-                screen_row = row
+                # the device row still covers shed policies (the
+                # compiled tensors don't re-splice per episode) — drop
+                # their cells so the hybrid merge below never denies or
+                # oracles a shed policy
+                screen_row = ([t for t in row if t[0] not in shed_names]
+                              if shed_names else row)
 
         if enforce and not screened_clean:
             # rule-level hybrid merge: policies the device already cleared
@@ -742,7 +778,7 @@ class WebhookServer:
                             break
                         full_row.append((policy.name, rule.name, v,
                                          rule.message))
-                if cacheable:
+                if cacheable and not shed_names:
                     self.admission_batcher.store_result(
                         PolicyType.VALIDATE_ENFORCE, kind, namespace,
                         resource, full_row, env=screen_env)
@@ -810,10 +846,20 @@ class WebhookServer:
         if namespace and self.resource_cache is not None:
             namespace_labels = self.resource_cache.get_namespace_labels(
                 namespace)
-        results = pool.evaluate(
-            [p.name for p in policies], resource, request, namespace_labels,
-            info.roles, info.cluster_roles,
-            self.config.get_exclude_group_role())
+        # guarded submission (runtime/sloactions.py): shrunk timeout +
+        # bounded retry + circuit breaking while the SLO actions plane
+        # is live; with KTPU_SLO_ACTIONS=0 this is exactly one call at
+        # the pool's historical default timeout
+        from . import sloactions
+
+        names = [p.name for p in policies]
+        results = sloactions.pool_evaluate(
+            pool, generation,
+            lambda timeout_s: pool.evaluate(
+                names, resource, request, namespace_labels,
+                info.roles, info.cluster_roles,
+                self.config.get_exclude_group_role(),
+                timeout_s=timeout_s))
         if results is None:
             return None
         by_name = dict(results)

@@ -15,11 +15,15 @@ import time
 
 class WorkerQueue:
     def __init__(self, handler, workers: int, name: str = "worker",
-                 max_queued: int = 0, max_retries: int = 1):
+                 max_queued: int = 0, max_retries: int = 1,
+                 shed_cb=None):
         self.handler = handler
         self.workers = workers
         self.name = name
         self.max_retries = max_retries
+        # optional degradation hook: truthy return sheds the enqueue
+        # before it touches the bounded queue (reason "slo")
+        self.shed_cb = shed_cb
         self.queue: queue.Queue = queue.Queue(maxsize=max_queued)
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -27,13 +31,34 @@ class WorkerQueue:
         self._in_flight_lock = threading.Lock()
         self.processed = 0
         self.dropped = 0
+        self.dropped_by_reason = {"slo": 0, "full": 0}
+
+    def _record_shed(self, reason: str) -> None:
+        self.dropped += 1
+        self.dropped_by_reason[reason] = (
+            self.dropped_by_reason.get(reason, 0) + 1)
+        try:
+            from . import metrics as metrics_mod
+
+            metrics_mod.record_queue_shed(metrics_mod.registry(),
+                                          self.name, reason)
+        except Exception:
+            pass
 
     def add(self, item) -> bool:
+        if self.shed_cb is not None:
+            try:
+                shed = bool(self.shed_cb())
+            except Exception:
+                shed = False
+            if shed:
+                self._record_shed("slo")
+                return False
         try:
             self.queue.put_nowait((item, 0))
             return True
         except queue.Full:
-            self.dropped += 1
+            self._record_shed("full")
             return False
 
     def run(self) -> None:

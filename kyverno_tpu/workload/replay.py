@@ -39,7 +39,9 @@ from ..runtime import metrics as metrics_mod
 from ..runtime.policycache import PolicyType
 from ..runtime.workqueue import WorkerQueue
 
-MANIFEST_SCHEMA_VERSION = 1
+# v2: manifests carry an "slo" block (degradation controller state,
+# action log, shed set) and diff_manifests refuses to compare silently
+MANIFEST_SCHEMA_VERSION = 2
 
 LEGS = ("webhook", "stream_json", "stream_row", "stream_block",
         "background")
@@ -486,21 +488,35 @@ class ReplayDriver:
 
 
 def run_manifest(trace, leg_results: list[dict],
-                 path: str | None = None, note: str = "") -> dict:
+                 path: str | None = None, note: str = "",
+                 slo: dict | None = None) -> dict:
     """Persistable record of one replay run: trace identity + per-leg
     numbers + parity digests. Per-event verdict maps are dropped (the
     digest carries the comparison); everything kept is
-    schema-versioned so cross-PR diffs fail loudly on layout drift."""
+    schema-versioned so cross-PR diffs fail loudly on layout drift.
+
+    ``slo`` stamps the degradation controller's record (state,
+    transitions, engaged actions with enter/exit timestamps, shed set);
+    None captures the live controller, so a run that degraded mid-way
+    carries that fact in its manifest by default."""
     legs = {}
     for r in leg_results:
         slim = {k: v for k, v in r.items() if k != "verdicts"}
         legs[r["leg"]] = slim
+    if slo is None:
+        try:
+            from ..runtime.sloactions import controller
+
+            slo = controller().manifest_record()
+        except Exception:
+            slo = {"enabled": False, "state": "unknown"}
     manifest = {
         "schema_version": MANIFEST_SCHEMA_VERSION,
         "note": note,
         "trace": {"digest": trace.content_digest(),
                   "meta": trace.meta, **trace.stats()},
         "legs": legs,
+        "slo": slo,
     }
     if path:
         with open(path, "w") as f:
@@ -510,7 +526,11 @@ def run_manifest(trace, leg_results: list[dict],
 
 def diff_manifests(a: dict, b: dict) -> dict:
     """A/B diff of two run manifests (the cross-PR comparison): verdict
-    parity per common leg plus numeric deltas on throughput/latency."""
+    parity per common leg plus numeric deltas on throughput/latency.
+    The SLO block makes degradation state explicit — ``comparable`` is
+    False when the runs disagree on state, engaged actions, or shed
+    set, so a degraded run can't silently benchmark against a healthy
+    one."""
     if (a.get("schema_version") != MANIFEST_SCHEMA_VERSION
             or b.get("schema_version") != MANIFEST_SCHEMA_VERSION):
         raise ValueError("manifest schema_version mismatch")
@@ -529,4 +549,25 @@ def diff_manifests(a: dict, b: dict) -> dict:
             if k in la and k in lb and isinstance(la[k], (int, float)):
                 entry[f"{k}_delta"] = round(lb[k] - la[k], 3)
         out["legs"][leg] = entry
+    sa, sb = a.get("slo") or {}, b.get("slo") or {}
+
+    def _slo_key(s: dict) -> tuple:
+        return (s.get("state", "unknown"),
+                tuple(s.get("actions_active") or ()),
+                tuple(s.get("shed") or ()))
+    out["slo"] = {
+        "a": {"state": sa.get("state", "unknown"),
+              "actions_active": list(sa.get("actions_active") or ()),
+              "shed": list(sa.get("shed") or ()),
+              "degraded_entered": sum(
+                  1 for t in (sa.get("transitions") or ())
+                  if t.get("state") == "degraded")},
+        "b": {"state": sb.get("state", "unknown"),
+              "actions_active": list(sb.get("actions_active") or ()),
+              "shed": list(sb.get("shed") or ()),
+              "degraded_entered": sum(
+                  1 for t in (sb.get("transitions") or ())
+                  if t.get("state") == "degraded")},
+        "comparable": _slo_key(sa) == _slo_key(sb),
+    }
     return out

@@ -67,6 +67,29 @@ def test_clean_tree_is_silent(tmp_path):
     assert scan_tree(root) == []
 
 
+def test_all_accessor_forms_count_as_reads(tmp_path):
+    """The full accessor spectrum keeps a switch live and is subject to
+    KT501 — the SLO degradation plane reads via enabled_strict/raw, not
+    just enabled, and those must close the matrix too."""
+    root = _tree(tmp_path, modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'ON = featureplane.enabled_strict("KTPU_ALPHA")\n'
+         'RAW = featureplane.raw("KTPU_BETA")\n'),
+    ])
+    assert scan_tree(root) == []     # both declarations live, no KT502
+    root2 = _tree(tmp_path / "second", modules=[
+        ("runtime/a.py",
+         'from . import featureplane\n'
+         'A = featureplane.int_value("KTPU_ALPHA")\n'
+         'B = featureplane.float_value("KTPU_BETA")\n'
+         'G = featureplane.enabled_strict("KTPU_GHOST")\n'),
+    ])
+    diags = scan_tree(root2)
+    assert _codes(diags) == ["KT501"]
+    assert "KTPU_GHOST" in diags[0].message
+
+
 def test_undeclared_read_raises_kt501(tmp_path):
     root = _tree(tmp_path, modules=[
         ("runtime/a.py",

@@ -1,0 +1,141 @@
+"""Direct unit tests for runtime/leaderelection.LeaderElector
+(previously exercised only through the controller wiring): acquisition,
+renewal, expiry takeover after holder death, CAS races between two
+scanner replicas, and clean release on stop — all over FakeCluster's
+resourceVersion-guarded update semantics."""
+
+import threading
+import time
+
+from kyverno_tpu.runtime import leaderelection as le
+from kyverno_tpu.runtime.client import FakeCluster
+from kyverno_tpu.runtime.leaderelection import LeaderElector
+
+
+def _lease(cluster, name="kyverno", namespace="kyverno"):
+    return cluster.get_resource("coordination.k8s.io/v1", "Lease",
+                                namespace, name)
+
+
+def test_first_replica_acquires():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="scanner-a")
+    assert a.try_acquire_or_renew() is True
+    assert a.is_leader()
+    lease = _lease(cluster)
+    assert lease["spec"]["holderIdentity"] == "scanner-a"
+
+
+def test_holder_renews_and_advances_renew_time():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="scanner-a")
+    assert a.try_acquire_or_renew()
+    t0 = _lease(cluster)["spec"]["renewTime"]
+    time.sleep(0.02)
+    assert a.try_acquire_or_renew()
+    assert _lease(cluster)["spec"]["renewTime"] > t0
+    assert a.is_leader()
+
+
+def test_non_holder_defers_while_lease_fresh():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="scanner-a")
+    b = LeaderElector(cluster, identity="scanner-b")
+    assert a.try_acquire_or_renew()
+    assert b.try_acquire_or_renew() is False
+    assert not b.is_leader()
+    assert _lease(cluster)["spec"]["holderIdentity"] == "scanner-a"
+
+
+def test_takeover_after_holder_death(monkeypatch):
+    """The holder stops renewing without releasing; once the lease
+    expires the survivor takes over and the dead holder's next attempt
+    observes the loss."""
+    monkeypatch.setattr(le, "LEASE_DURATION_S", 0.1)
+    cluster = FakeCluster()
+    stopped = []
+    a = LeaderElector(cluster, identity="scanner-a",
+                      on_stopped_leading=lambda: stopped.append("a"))
+    b = LeaderElector(cluster, identity="scanner-b")
+    assert a.try_acquire_or_renew()
+    time.sleep(0.15)                 # renewTime now past the lease
+    assert b.try_acquire_or_renew() is True
+    assert b.is_leader()
+    assert a.try_acquire_or_renew() is False
+    assert not a.is_leader()
+    assert stopped == ["a"]
+
+
+def test_expired_lease_race_elects_exactly_one():
+    """Two replicas CAS the same expired lease concurrently: the
+    resourceVersion guard must admit exactly one winner per round."""
+    for seed in range(8):
+        now = time.time()
+        cluster = FakeCluster([{
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {"name": "kyverno", "namespace": "kyverno"},
+            "spec": {"holderIdentity": "scanner-dead",
+                     "leaseDurationSeconds": 15,
+                     "renewTime": now - 100.0},
+        }])
+        a = LeaderElector(cluster, identity="scanner-a")
+        b = LeaderElector(cluster, identity="scanner-b")
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def race(elector, key):
+            barrier.wait()
+            results[key] = elector.try_acquire_or_renew()
+
+        ta = threading.Thread(target=race, args=(a, "a"))
+        tb = threading.Thread(target=race, args=(b, "b"))
+        ta.start()
+        tb.start()
+        ta.join(5.0)
+        tb.join(5.0)
+        assert sum(results.values()) == 1, (seed, results)
+        winner = "scanner-a" if results["a"] else "scanner-b"
+        assert _lease(cluster)["spec"]["holderIdentity"] == winner
+
+
+def test_stop_releases_lease_for_immediate_takeover():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="scanner-a")
+    b = LeaderElector(cluster, identity="scanner-b")
+    assert a.try_acquire_or_renew()
+    a.stop()
+    assert not a.is_leader()
+    assert _lease(cluster)["spec"]["holderIdentity"] == ""
+    # no expiry wait needed: the released lease is free right now
+    assert b.try_acquire_or_renew() is True
+
+
+def test_run_loop_renews_and_survivor_takes_over(monkeypatch):
+    """End to end on real threads with a compressed lease: the loop
+    keeps the holder leading; killing its loop (no release) hands the
+    lease to the survivor within a couple of lease durations."""
+    monkeypatch.setattr(le, "LEASE_DURATION_S", 0.3)
+    cluster = FakeCluster()
+    started = []
+    a = LeaderElector(cluster, identity="scanner-a",
+                      on_started_leading=lambda: started.append("a"))
+    b = LeaderElector(cluster, identity="scanner-b",
+                      on_started_leading=lambda: started.append("b"))
+    a.run(retry_period_s=0.05)
+    b.run(retry_period_s=0.05)
+    try:
+        deadline = time.monotonic() + 3.0
+        while not a.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert a.is_leader() and not b.is_leader()
+        time.sleep(0.4)              # past one lease duration:
+        assert a.is_leader()         # the loop renewed, no takeover
+        a._stop.set()                # holder death, lease not released
+        deadline = time.monotonic() + 3.0
+        while not b.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.is_leader()
+        assert started == ["a", "b"]
+    finally:
+        a._stop.set()
+        b.stop()

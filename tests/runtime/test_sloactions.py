@@ -1,0 +1,446 @@
+"""Unit battery for runtime/sloactions: the hysteresis state machine,
+action engagement diffs, shed ranking, the generation-guarded pool
+circuit, and the guarded pool submission path — all on injected clocks
+and synthetic policy/attribution state, no serving stack."""
+
+import pytest
+
+from kyverno_tpu.runtime import sloactions
+from kyverno_tpu.runtime.sloactions import (POOL_TIMEOUT_DEFAULT_S,
+                                            DegradationController,
+                                            PoolCircuit, pool_evaluate)
+
+DEG = {"degraded": True}
+OK = {"degraded": False}
+
+
+class Clock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Master + all four rungs on, second-scale hysteresis."""
+    for k, v in {"KTPU_SLO_ACTIONS": "1", "KTPU_SLO_SHED": "1",
+                 "KTPU_SLO_GEOMETRY": "1", "KTPU_SLO_HOSTBOUND": "1",
+                 "KTPU_SLO_SCALE_HINTS": "1",
+                 "KTPU_SLO_DEGRADE_AFTER_S": "1.0",
+                 "KTPU_SLO_RECOVER_AFTER_S": "2.0",
+                 "KTPU_SLO_MIN_DWELL_S": "0.5"}.items():
+        monkeypatch.setenv(k, v)
+    yield monkeypatch
+
+
+def _degrade(c: DegradationController, clk: Clock) -> None:
+    """Drive a fresh controller into the degraded state."""
+    c.tick(OK)
+    c.tick(DEG)                      # streak starts
+    clk.advance(1.2)                 # > degrade_after and > min dwell
+    c.tick(DEG)
+    assert c.state == "degraded"
+
+
+class TestHysteresis:
+    def test_degrade_needs_sustained_signal(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        c.tick(DEG)                  # first sighting: streak = 0
+        assert c.state == "healthy"
+        clk.advance(0.5)
+        c.tick(DEG)                  # 0.5s < degrade_after 1.0
+        assert c.state == "healthy"
+        clk.advance(0.6)
+        c.tick(DEG)                  # 1.1s sustained
+        assert c.state == "degraded"
+        assert c.stats["degraded_entered"] == 1
+
+    def test_recover_slow(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        clk.advance(0.6)
+        c.tick(OK)                   # healthy streak starts
+        clk.advance(1.0)
+        c.tick(OK)                   # 1.0s < recover_after 2.0
+        assert c.state == "degraded"
+        clk.advance(1.1)
+        c.tick(OK)                   # 2.1s sustained
+        assert c.state == "healthy"
+        assert c.stats["recovered"] == 1
+
+    def test_flap_suppressed_by_min_dwell(self, armed):
+        armed.setenv("KTPU_SLO_RECOVER_AFTER_S", "0.0")
+        armed.setenv("KTPU_SLO_MIN_DWELL_S", "5.0")
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        c.tick(OK)
+        clk.advance(5.1)             # dwell applies to BOTH directions:
+        c.tick(DEG)                  # serve it out healthy first
+        clk.advance(1.2)
+        c.tick(DEG)
+        assert c.state == "degraded"
+        clk.advance(1.0)
+        c.tick(OK)                   # recover_after met, dwell not
+        assert c.state == "degraded"
+        clk.advance(4.5)             # dwell 5.5s > 5.0 now
+        c.tick(OK)
+        assert c.state == "healthy"
+
+    def test_interrupted_streak_resets(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        c.tick(DEG)
+        clk.advance(0.8)
+        c.tick(OK)                   # signal clears mid-streak
+        clk.advance(0.5)
+        c.tick(DEG)                  # new streak from scratch
+        assert c.state == "healthy"
+
+    def test_state_seconds_accounted_in_both_states(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        c.tick(OK)
+        clk.advance(2.0)
+        _degrade(c, clk)
+        clk.advance(3.0)
+        c.tick(DEG)
+        rep = c.report()
+        assert rep["state_seconds"]["healthy"] > 0
+        assert rep["state_seconds"]["degraded"] >= 3.0
+
+    def test_idle_ticks_still_account(self, armed):
+        """The slo_degraded_flushes evidence gap: time accrues on
+        snapshotless ticks too, not just when a flush fires."""
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        for _ in range(5):
+            clk.advance(0.5)
+            c.tick(DEG)              # nothing flushing, still counted
+        assert c.report()["state_seconds"]["degraded"] >= 2.5
+
+    def test_transitions_carry_timestamps(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        clk.advance(0.6)
+        c.tick(OK)
+        clk.advance(2.1)
+        c.tick(OK)
+        states = [t["state"] for t in c.transitions]
+        assert states == ["degraded", "healthy"]
+        assert all("enter_t" in t for t in c.transitions)
+        assert "exit_t" in c.transitions[0]
+
+
+class TestActionEngagement:
+    def test_ladder_engages_and_exits(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        assert c.active_actions() == []
+        _degrade(c, clk)
+        assert c.active_actions() == list(sloactions.ACTIONS)
+        clk.advance(0.6)
+        c.tick(OK)
+        clk.advance(2.1)
+        c.tick(OK)
+        assert c.active_actions() == []
+        entered = [e["action"] for e in c.action_log
+                   if e["event"] == "enter"]
+        exited = [e["action"] for e in c.action_log
+                  if e["event"] == "exit"]
+        assert entered == exited == list(sloactions.ACTIONS)
+        assert all("t" in e for e in c.action_log)
+
+    def test_per_action_switch_respected(self, armed):
+        armed.setenv("KTPU_SLO_GEOMETRY", "0")
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        assert "geometry" not in c.active_actions()
+        assert "shed" in c.active_actions()
+
+    def test_master_kill_mid_episode(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        assert c.active_actions()
+        armed.setenv("KTPU_SLO_ACTIONS", "0")
+        # the gate is live: consults stop immediately, before any tick
+        assert c.active_actions() == []
+        clk.advance(0.1)
+        c.tick(DEG)                  # next tick stands the ladder down
+        assert not c._engaged
+        assert [e["event"] for e in c.action_log[-4:]] == ["exit"] * 4
+
+    def test_master_off_never_engages(self, armed):
+        armed.setenv("KTPU_SLO_ACTIONS", "0")
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)             # state machine still runs...
+        assert c.action_log == []    # ...but annotate-only: no actions
+        assert c.report()["enabled"] is False
+
+
+class _Spec:
+    def __init__(self, action):
+        self.validation_failure_action = action
+
+
+class _Pol:
+    def __init__(self, name, action="enforce"):
+        self.name = name
+        self.spec = _Spec(action)
+
+
+class _Cache:
+    def __init__(self, policies):
+        self._policies = policies
+        self.generation = 1
+
+    def snapshot(self):
+        return self.generation, list(self._policies)
+
+
+class TestShed:
+    def _controller(self, monkeypatch, policies, impact, sevs,
+                    shed_max="2"):
+        monkeypatch.setenv("KTPU_SLO_SHED_MAX", shed_max)
+        monkeypatch.setattr(sloactions, "_attribution_impact",
+                            lambda: impact)
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        monkeypatch.setattr(c, "_lint_severities",
+                            lambda gen, pols: sevs)
+        c.attach(_Cache(policies))
+        return c, clk
+
+    def test_least_impact_sheds_first(self, armed):
+        pols = [_Pol("a"), _Pol("b"), _Pol("c")]
+        c, clk = self._controller(
+            armed, pols, impact={"a": 5, "b": 1, "c": 9}, sevs={})
+        _degrade(c, clk)
+        assert c.shed == ["b", "a"]  # capped at 2, impact ascending
+        assert c.shed_active_names() == frozenset({"b", "a"})
+
+    def test_error_severity_never_sheds(self, armed):
+        pols = [_Pol("a"), _Pol("b")]
+        c, clk = self._controller(
+            armed, pols, impact={}, sevs={"a": 2})   # a is ERROR-flagged
+        _degrade(c, clk)
+        assert c.shed == ["b"]
+
+    def test_audit_policies_not_candidates(self, armed):
+        pols = [_Pol("a", action="audit"), _Pol("b")]
+        c, clk = self._controller(armed, pols, impact={}, sevs={})
+        _degrade(c, clk)
+        assert c.shed == ["b"]       # audit never blocks, never sheds
+
+    def test_generation_churn_recomputes(self, armed):
+        pols = [_Pol("a"), _Pol("b")]
+        c, clk = self._controller(
+            armed, pols, impact={"a": 1, "b": 5}, sevs={})
+        _degrade(c, clk)
+        assert c.shed == ["a", "b"]
+        before = c.stats["shed_recomputes"]
+        c._policy_cache.generation = 2
+        c._policy_cache._policies = [_Pol("b")]
+        clk.advance(0.1)
+        c.tick(DEG)
+        assert c.stats["shed_recomputes"] == before + 1
+        assert c.shed == ["b"]
+
+    def test_shed_set_rides_log_entries(self, armed):
+        pols = [_Pol("a")]
+        c, clk = self._controller(armed, pols, impact={}, sevs={},
+                                  shed_max="1")
+        _degrade(c, clk)
+        clk.advance(0.6)
+        c.tick(OK)
+        clk.advance(2.1)
+        c.tick(OK)                   # recovered: shed cleared...
+        assert c.shed == []
+        logged = [e for e in c.action_log if e["action"] == "shed"]
+        # ...but both the enter and the exit record what was shed
+        assert all(e.get("shed") == ["a"] for e in logged)
+
+    def test_empty_without_cache(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        _degrade(c, clk)
+        assert c.shed == []
+        assert c.shed_active_names() == frozenset()
+
+
+class TestPoolCircuit:
+    @pytest.fixture
+    def breaker_env(self, armed):
+        armed.setenv("KTPU_SLO_BREAKER_THRESHOLD", "2")
+        armed.setenv("KTPU_SLO_BREAKER_COOLDOWN_S", "10.0")
+        return armed
+
+    def test_opens_on_threshold(self, breaker_env):
+        clk = Clock()
+        cb = PoolCircuit(clock=clk)
+        assert cb.allow(1)
+        cb.record(False, 1)
+        assert cb.state == "closed"
+        cb.record(False, 1)
+        assert cb.state == "open"
+        assert not cb.allow(1)
+        assert cb.stats == {"opened": 1, "closed": 0, "probes": 0,
+                            "rejected": 1, "failures": 2}
+
+    def test_half_open_single_probe_then_close(self, breaker_env):
+        clk = Clock()
+        cb = PoolCircuit(clock=clk)
+        cb.record(False, 1)
+        cb.record(False, 1)
+        clk.advance(10.1)
+        assert cb.allow(1)           # cooldown expired: the probe
+        assert cb.state == "half_open"
+        assert not cb.allow(1)       # exactly one probe owns the lane
+        cb.record(True, 1)
+        assert cb.state == "closed"
+        assert cb.allow(1)
+
+    def test_half_open_failure_reopens(self, breaker_env):
+        clk = Clock()
+        cb = PoolCircuit(clock=clk)
+        cb.record(False, 1)
+        cb.record(False, 1)
+        clk.advance(10.1)
+        assert cb.allow(1)
+        cb.record(False, 1)          # probe failed
+        assert cb.state == "open"
+        assert cb.stats["opened"] == 2
+
+    def test_generation_change_probes_before_cooldown(self, breaker_env):
+        clk = Clock()
+        cb = PoolCircuit(clock=clk)
+        cb.record(False, 1)
+        cb.record(False, 1)
+        assert not cb.allow(1)       # same generation: wait out cooldown
+        assert cb.allow(2)           # rebuilt pool: immediate probe
+        assert cb.state == "half_open"
+
+    def test_stale_generation_probe_cannot_close(self, breaker_env):
+        clk = Clock()
+        cb = PoolCircuit(clock=clk)
+        cb.record(False, 1)
+        cb.record(False, 1)
+        assert cb.allow(2)           # probing generation 2
+        cb.record(True, 3)           # success against a *newer* pool
+        assert cb.state == "half_open"   # proves nothing: stay probing
+        cb.record(True, 2)           # the probed generation succeeds
+        assert cb.state == "closed"
+
+    def test_noop_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SLO_ACTIONS", "0")
+        cb = PoolCircuit()
+        for _ in range(10):
+            cb.record(False, 1)
+        assert cb.state == "closed"
+        assert cb.allow(1)
+
+
+class TestPoolEvaluate:
+    @pytest.fixture(autouse=True)
+    def fresh_singletons(self):
+        sloactions.circuit().reset()
+        sloactions.controller().reset()
+        yield
+        sloactions.circuit().reset()
+        sloactions.controller().reset()
+
+    def test_master_off_is_the_legacy_call(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SLO_ACTIONS", "0")
+        calls = []
+
+        def submit(timeout_s):
+            calls.append(timeout_s)
+            return None              # a miss must NOT retry when off
+
+        assert pool_evaluate(None, 1, submit) is None
+        assert calls == [POOL_TIMEOUT_DEFAULT_S]
+
+    def test_miss_retries_with_backoff(self, armed):
+        armed.setenv("KTPU_SLO_POOL_RETRIES", "1")
+        calls = []
+
+        def submit(timeout_s):
+            calls.append(timeout_s)
+            return ["hit"] if len(calls) == 2 else None
+
+        assert pool_evaluate(None, 7, submit) == ["hit"]
+        assert len(calls) == 2
+        assert sloactions.circuit().state == "closed"
+
+    def test_open_circuit_sheds_submission(self, armed):
+        armed.setenv("KTPU_SLO_BREAKER_THRESHOLD", "1")
+        armed.setenv("KTPU_SLO_BREAKER_COOLDOWN_S", "60.0")
+        armed.setenv("KTPU_SLO_POOL_RETRIES", "0")
+        assert pool_evaluate(None, 1, lambda t: None) is None
+        assert sloactions.circuit().state == "open"
+        calls = []
+        assert pool_evaluate(None, 1,
+                             lambda t: calls.append(t)) is None
+        assert calls == []           # rejected without touching the pool
+
+    def test_submit_exception_counts_as_miss(self, armed):
+        armed.setenv("KTPU_SLO_POOL_RETRIES", "0")
+
+        def submit(timeout_s):
+            raise RuntimeError("worker died")
+
+        assert pool_evaluate(None, 1, submit) is None
+        assert sloactions.circuit().stats["failures"] == 1
+
+
+class TestConsultSurfaces:
+    @pytest.fixture
+    def engaged(self, armed):
+        clk = Clock()
+        c = DegradationController(clock=clk)
+        armed.setattr(sloactions, "_controller", c)
+        _degrade(c, clk)
+        return c
+
+    def test_geometry_profile(self, armed, engaged):
+        armed.setenv("KTPU_SLO_WINDOW_FACTOR", "0.25")
+        armed.setenv("KTPU_SLO_PAD_FLOOR", "8")
+        assert sloactions.window_scale() == 0.25
+        assert sloactions.effective_pad_floor(64) == 8
+        assert sloactions.effective_pad_floor(4) == 4   # never raises
+
+    def test_geometry_identity_when_healthy(self, armed, monkeypatch):
+        monkeypatch.setattr(sloactions, "_controller",
+                            DegradationController(clock=Clock()))
+        assert sloactions.window_scale() == 1.0
+        assert sloactions.effective_pad_floor(64) == 64
+
+    def test_fanout_bound(self, armed, engaged):
+        armed.setenv("KTPU_SLO_FANOUT_MAX", "2")
+        assert sloactions.fanout_bound() == 2
+        armed.setenv("KTPU_SLO_HOSTBOUND", "0")
+        assert sloactions.fanout_bound() is None
+
+    def test_scale_hint_tracks_burn(self, armed, engaged):
+        engaged.tick({"degraded": True,
+                      "burn_rate": {"short": 2.3, "long": 1.1}})
+        hint = engaged.scale_hint()
+        assert hint["replicas_delta"] == 3    # ceil(2.3), clamped [1,4]
+
+    def test_manifest_record_shape(self, armed, engaged):
+        rec = engaged.manifest_record()
+        assert rec["state"] == "degraded"
+        assert rec["actions_active"] == list(sloactions.ACTIONS)
+        assert set(rec["state_seconds"]) == {"healthy", "degraded"}
+        assert rec["transitions"][-1]["state"] == "degraded"

@@ -100,3 +100,64 @@ def test_stop_terminates_workers():
     assert wq._threads == []
     for t in threads:
         assert not t.is_alive()
+
+
+class TestShedReasons:
+    """Degradation-plane tagging: every dropped enqueue carries a
+    reason — backpressure ("full") vs the SLO shed hook ("slo") — so an
+    operator can tell a storm from a deliberate brownout response."""
+
+    def test_full_queue_tagged_full(self):
+        release = threading.Event()
+        wq = WorkerQueue(lambda item: release.wait(5.0), workers=1,
+                         name="t", max_queued=1)
+        wq.run()
+        for i in range(6):
+            wq.add(i)
+        dropped = wq.dropped
+        release.set()
+        wq.drain(timeout=5.0)
+        wq.stop()
+        assert dropped >= 1
+        assert wq.dropped_by_reason["full"] == dropped
+        assert wq.dropped_by_reason["slo"] == 0
+
+    def test_shed_cb_tagged_slo_and_skips_queue(self):
+        shedding = [True]
+        wq = WorkerQueue(lambda item: None, workers=1, name="t",
+                         shed_cb=lambda: shedding[0])
+        wq.run()
+        assert wq.add("a") is False       # shed before the queue
+        assert wq.add("b") is False
+        shedding[0] = False
+        assert wq.add("c") is True        # hook released: flows again
+        wq.drain(timeout=5.0)
+        wq.stop()
+        assert wq.dropped_by_reason == {"slo": 2, "full": 0}
+        assert wq.processed == 1
+
+    def test_shed_counter_labelled_by_reason(self):
+        from kyverno_tpu.runtime import metrics as metrics_mod
+
+        reg = metrics_mod.registry()
+        name = "t-shed-metric"
+        before = reg.counter_value("kyverno_queue_sheds_total",
+                                   {"queue": name, "reason": "slo"}) or 0
+        wq = WorkerQueue(lambda item: None, workers=1, name=name,
+                         shed_cb=lambda: True)
+        assert wq.add("a") is False
+        after = reg.counter_value("kyverno_queue_sheds_total",
+                                  {"queue": name, "reason": "slo"})
+        assert after == before + 1
+
+    def test_shed_cb_exception_fails_open(self):
+        def boom():
+            raise RuntimeError("hook died")
+
+        wq = WorkerQueue(lambda item: None, workers=1, name="t",
+                         shed_cb=boom)
+        wq.run()
+        assert wq.add("a") is True        # a broken hook must not shed
+        wq.drain(timeout=5.0)
+        wq.stop()
+        assert wq.dropped == 0

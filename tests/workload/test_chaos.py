@@ -1,0 +1,157 @@
+"""Chaos harness tests: the injectors restore what they disturb, the
+brownout pool honors the miss contract, the replay manifest carries the
+SLO record, and one full (small) scenario closes the loop end to end
+— the latter marked slow; deploy/chaos_smoke.py is the CI gate."""
+
+import os
+import time
+
+import pytest
+
+from kyverno_tpu.workload import chaos
+
+
+class _Webhook:
+    """Minimal stand-in exposing the one method the latency injector
+    wraps."""
+
+    calls = 0
+
+    def _resource_validation(self, request):
+        type(self).calls += 1
+        return ("verdict", request)
+
+
+class TestInjectors:
+    def test_inject_latency_wraps_and_restores(self):
+        w = _Webhook()
+        orig = w._resource_validation
+        with chaos.inject_latency(w, 0.02):
+            t0 = time.monotonic()
+            out = w._resource_validation("req")
+            assert time.monotonic() - t0 >= 0.02
+            assert out == ("verdict", "req")     # delegates faithfully
+        # instance shadow removed: back to the class method
+        assert w._resource_validation.__func__ is orig.__func__
+
+    def test_inject_latency_restores_on_error(self):
+        w = _Webhook()
+        try:
+            with chaos.inject_latency(w, 0.0):
+                raise RuntimeError("scenario died")
+        except RuntimeError:
+            pass
+        assert "_resource_validation" not in vars(w)
+
+    def test_brownout_pool_misses_within_timeout(self):
+        pool = chaos.BrownoutPool(latency_s=10.0)
+        t0 = time.monotonic()
+        assert pool.evaluate_payload([], {}, {}, timeout_s=0.05) is None
+        assert time.monotonic() - t0 < 1.0       # burns timeout, not 10s
+        assert pool.ready(1) and pool.enabled
+        assert pool.stats["misses"] == 1
+
+    def test_env_overrides_restore_absence_and_value(self):
+        os.environ["KTPU_CHAOS_T_PRESENT"] = "orig"
+        os.environ.pop("KTPU_CHAOS_T_ABSENT", None)
+        with chaos.env_overrides({"KTPU_CHAOS_T_PRESENT": "changed",
+                                  "KTPU_CHAOS_T_ABSENT": "set"}):
+            assert os.environ["KTPU_CHAOS_T_PRESENT"] == "changed"
+            assert os.environ["KTPU_CHAOS_T_ABSENT"] == "set"
+        assert os.environ.pop("KTPU_CHAOS_T_PRESENT") == "orig"
+        assert "KTPU_CHAOS_T_ABSENT" not in os.environ
+
+    def test_fast_env_declared_switches_only(self):
+        from kyverno_tpu.runtime.featureplane import REGISTRY
+
+        env = chaos.fast_env()
+        assert env["KTPU_SLO_ACTIONS"] == "1"
+        assert chaos.fast_env(actions="0")["KTPU_SLO_ACTIONS"] == "0"
+        undeclared = [k for k in env if k not in REGISTRY]
+        assert undeclared == [], undeclared
+
+    def test_shrunk_lease_restores_constants(self):
+        from kyverno_tpu.runtime import leaderelection as le
+
+        saved = (le.LEASE_DURATION_S, le.RENEW_DEADLINE_S,
+                 le.RETRY_PERIOD_S)
+        with chaos.shrunk_lease(duration_s=0.6):
+            assert le.LEASE_DURATION_S == 0.6
+            assert le.RENEW_DEADLINE_S < 0.6
+        assert (le.LEASE_DURATION_S, le.RENEW_DEADLINE_S,
+                le.RETRY_PERIOD_S) == saved
+
+    def test_inject_replica_loss_takeover(self):
+        results = {}
+        with chaos.inject_replica_loss(results):
+            pass
+        assert results["first_leader"] == "scanner-a"
+        assert results["race_single_leader"]
+        assert results["takeover"]
+        assert results["takeover_s"] < 5.0
+
+
+class TestManifestSlo:
+    def test_run_manifest_carries_explicit_slo(self, tmp_path):
+        from kyverno_tpu.workload.replay import (MANIFEST_SCHEMA_VERSION,
+                                                 run_manifest)
+        from kyverno_tpu.workload.trace import synthesize
+
+        tr = synthesize(events=8, seed=3)
+        leg = {"leg": "webhook", "events": 8, "verdict_digest": "d0"}
+        slo = {"enabled": True, "state": "degraded", "shed": ["p"],
+               "actions_active": ["shed"], "action_log": []}
+        m = run_manifest(tr, [leg], path=str(tmp_path / "m.json"),
+                         slo=slo)
+        assert m["schema_version"] == MANIFEST_SCHEMA_VERSION >= 2
+        assert m["slo"]["state"] == "degraded"
+
+    def test_run_manifest_autocaptures_controller(self):
+        from kyverno_tpu.runtime import sloactions
+        from kyverno_tpu.workload.replay import run_manifest
+        from kyverno_tpu.workload.trace import synthesize
+
+        sloactions.controller().reset()
+        tr = synthesize(events=8, seed=3)
+        m = run_manifest(tr, [{"leg": "webhook", "events": 8,
+                               "verdict_digest": "d0"}])
+        assert m["slo"]["state"] == "healthy"
+        assert m["slo"]["shed"] == []
+
+    def test_diff_manifests_flags_slo_incomparability(self):
+        from kyverno_tpu.workload.replay import (diff_manifests,
+                                                 run_manifest)
+        from kyverno_tpu.workload.trace import synthesize
+
+        tr = synthesize(events=8, seed=3)
+        leg = {"leg": "webhook", "events": 8, "verdict_digest": "d0"}
+        healthy = {"enabled": True, "state": "healthy", "shed": [],
+                   "actions_active": [], "action_log": []}
+        shedding = {"enabled": True, "state": "degraded", "shed": ["p"],
+                    "actions_active": ["shed"], "action_log": []}
+        ma = run_manifest(tr, [leg], slo=healthy)
+        mb = run_manifest(tr, [leg], slo=healthy)
+        mc = run_manifest(tr, [leg], slo=shedding)
+        assert diff_manifests(ma, mb)["slo"]["comparable"] is True
+        d = diff_manifests(ma, mc)
+        assert d["slo"]["comparable"] is False
+        assert d["slo"]["b"]["shed"] == ["p"]
+
+
+@pytest.mark.slow
+class TestScenarioEndToEnd:
+    def test_arrival_storm_closes_the_loop(self):
+        rep = chaos.run_scenario("arrival_storm", events=24,
+                                 delay_s=0.35, workers=6)
+        assert rep["ok"], rep["checks"]
+        assert rep["checks"]["recovery_digest_matches"]
+        entered = [e for e in rep["action_log"] if e["event"] == "enter"]
+        assert entered and all("t" in e for e in rep["action_log"])
+        assert rep["manifest"]["slo"]["state"] == "healthy"
+
+    def test_killswitch_restores_annotate_only(self):
+        rep = chaos.run_scenario("arrival_storm", events=24,
+                                 delay_s=0.35, workers=6, actions="0")
+        assert rep["ok"], rep["checks"]
+        assert rep["checks"]["no_actions_engaged"]
+        assert rep["checks"]["episode_digest_matches"]
