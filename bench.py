@@ -2103,6 +2103,153 @@ def bench_config11(jax):
     }
 
 
+def _mesh_library(n_policies: int = 256, rules_per: int = 8) -> list:
+    """>= 2k-rule synthetic library for the mesh A/B: every policy is a
+    distinct segment (the partitioner's unit), each carrying
+    ``rules_per`` device-lane pattern rules that actually discriminate
+    on the trace generator's Pod bodies (images, labels, names), plus a
+    thin host-lane slice so the 2D path exercises oracle resolution."""
+    from kyverno_tpu.api.load import load_policy
+
+    shapes = [
+        lambda k: {"spec": {"containers": [{"image": "!*:latest"}]}},
+        lambda k: {"metadata": {"labels": {"app": "?*"}}},
+        lambda k: {"metadata": {"labels": {"team": "?*"}}},
+        lambda k: {"metadata": {"name": "app-?*"}},
+        lambda k: {"spec": {"containers": [{"name": "?*"}]}},
+        lambda k: {"spec": {"containers": [{"image": "registry.local/*"}]}},
+        lambda k: {"metadata": {"namespace": "team-*"}},
+        lambda k: {"spec": {"containers": [
+            {"image": f"!*:v{k % 7}"}]}},
+    ]
+    out = []
+    for i in range(n_policies):
+        if i % 64 == 63:
+            rules = [{
+                "name": "echo-name",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": f"host echo {i}",
+                             "pattern": {"metadata": {"name":
+                                 "{{request.object.metadata.name}}"}}},
+            }]
+        else:
+            rules = [{
+                "name": f"r{j}",
+                "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": f"p{i} r{j}",
+                             "pattern": shapes[(i + j) % len(shapes)](i + j)},
+            } for j in range(rules_per)]
+        out.append(load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": f"mesh-lib-{i}"},
+            "spec": {"validationFailureAction": "enforce",
+                     "rules": rules}}))
+    return out
+
+
+def bench_config12(jax):
+    """2D mesh A/B (round 12): segment-aligned policy sharding. The
+    macro corpus comes from the workload plane — the round-11 trace
+    generator's live set after a churn trace replays (create/update/
+    delete applied in order) — and a >= 2k-rule synthetic library scans
+    it three ways: unsharded single-device, 1D data mesh (policy
+    tensors replicated on every device), and the 2D ``(policy, data)``
+    mesh (auto-factored geometry, per-shard tensors only on their own
+    row). Acceptance: all three verdict digests identical, and each
+    policy shard's device-resident tensor bytes within the
+    ``1/policy_shards`` budget (x2 for the pow2 rule bucket) of the
+    replicated 1D footprint. On hosts without enough devices for a
+    policy axis the A/B still runs (degenerate (1, N) grid) but the
+    footprint leg reports ``degraded``."""
+    import hashlib
+
+    from kyverno_tpu.models.compiler import tensor_nbytes
+    from kyverno_tpu.models.engine import IncrementalCompiler
+    from kyverno_tpu.parallel import make_mesh, sharded_scan
+    from kyverno_tpu.parallel.mesh import parse_mesh_shape
+    from kyverno_tpu.workload.trace import synthesize
+
+    policies = _mesh_library()
+
+    # macro corpus: the live set a churn trace leaves behind
+    tr = synthesize(events=3000, namespaces=8, zipf_s=1.1,
+                    distinct_bodies=64, update_fraction=0.2,
+                    delete_fraction=0.05, storm_factor=6.0,
+                    storm_period=500, seed=12)
+    live = {}
+    for ev in tr.events:
+        key = (ev.namespace, ev.kind, ev.name)
+        if ev.op == "DELETE":
+            live.pop(key, None)
+        elif ev.op in ("CREATE", "UPDATE"):
+            live[key] = tr.bodies[ev.digest]
+    corpus = list(live.values())
+
+    inc = IncrementalCompiler()
+    cps = inc.refresh(policies)
+    live_rules = cps.tensors.n_rules_live
+
+    def digest(v):
+        return hashlib.sha256(
+            np.ascontiguousarray(v).tobytes()).hexdigest()[:16]
+
+    t0 = time.perf_counter()
+    v0 = np.asarray(cps.evaluate(corpus))
+    t_unsharded = time.perf_counter() - t0
+
+    mesh1 = make_mesh()
+    t0 = time.perf_counter()
+    v1, _, _ = sharded_scan(cps, corpus, mesh1)
+    t_1d = time.perf_counter() - t0
+
+    n_dev = len(jax.devices())
+    shape = parse_mesh_shape("auto", n_dev) or (1, n_dev)
+    mesh2 = make_mesh(shape=shape)
+    sps = inc.refresh_sharded(policies, shape[0])
+    t0 = time.perf_counter()
+    v2, _, _ = sharded_scan(sps, corpus, mesh2)
+    t_2d = time.perf_counter() - t0
+
+    digests = {digest(v0), digest(v1), digest(v2)}
+
+    full_bytes = tensor_nbytes(cps.tensors)
+    shard_bytes = sps.shard_tensor_bytes()
+    max_shard = max(shard_bytes.values())
+    # the pow2 rule bucket can at most double a shard's rule axis, and
+    # the dictionary-scale tables (paths, NFA) replicate per shard
+    budget = 1.0 if shape[0] == 1 else (2.0 / shape[0] + 0.35)
+    footprint_ok = (shape[0] == 1) or (max_shard / full_bytes <= budget)
+
+    met = (len(digests) == 1 and footprint_ok
+           and corpus and live_rules >= 2000)
+    return {
+        "devices": n_dev,
+        "mesh_shape": list(shape),
+        "library": {"policies": len(policies), "rules": live_rules},
+        "corpus_rows": len(corpus),
+        "trace": tr.stats(),
+        "verdict_digest": next(iter(digests)) if len(digests) == 1
+        else sorted(digests),
+        "scan_s": {"unsharded": round(t_unsharded, 3),
+                   "mesh_1d": round(t_1d, 3),
+                   "mesh_2d": round(t_2d, 3)},
+        "rows_per_s_2d": round(len(corpus) / t_2d, 1),
+        "tensor_bytes": {
+            "full_replicated_per_device": full_bytes,
+            "per_shard": {str(k): v for k, v in shard_bytes.items()},
+            "max_shard_over_full": round(max_shard / full_bytes, 4),
+            "budget": round(budget, 4),
+            "degraded": shape[0] == 1,
+        },
+        "shard_rules": {str(k): v
+                        for k, v in sps.shard_rule_counts().items()},
+        "target": "unsharded/1D/2D verdict digests identical over a "
+                  ">=2k-rule library; per-shard tensor bytes within the "
+                  "1/policy_shards (+pow2/dictionary slack) budget",
+        "met": bool(met),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -2123,7 +2270,8 @@ def main() -> None:
                     ("7_host_heavy_mix", bench_config7),
                     ("9_streaming_open_loop", bench_config9),
                     ("10_trace_replay", bench_config10),
-                    ("11_chaos_storm", bench_config11)):
+                    ("11_chaos_storm", bench_config11),
+                    ("12_mesh_2d", bench_config12)):
         if only and name.split("_")[0] not in only:
             continue
         try:

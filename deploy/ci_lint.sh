@@ -54,6 +54,9 @@ JAX_PLATFORMS=cpu python deploy/replay_smoke.py || rc=1
 echo "== chaos smoke (brownout degrade->act->recover, KTPU_SLO_ACTIONS=0 parity)"
 JAX_PLATFORMS=cpu python deploy/chaos_smoke.py || rc=1
 
+echo "== mesh smoke (1D/2D verdict parity, KT305 partition, kill switch)"
+JAX_PLATFORMS=cpu python deploy/mesh_smoke.py || rc=1
+
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2
 else

@@ -1,0 +1,203 @@
+"""2D mesh smoke for CI (deploy/ci_lint.sh).
+
+Forces 4 virtual CPU devices and proves the PR-14 mesh contract on a
+mixed-lane synthetic corpus (device pattern rules + host-lane rules):
+
+1. geometry — ``KTPU_MESH_SHAPE=2x2`` turns :func:`make_mesh` into the
+   2D ``(policy, data)`` grid, ``auto`` factors the device count, and
+   with the switch unset the mesh is the historical 1D ``(data,)`` one;
+2. verdict parity — the unsharded ``evaluate``, the 1D ``sharded_scan``
+   and the 2D ``sharded_scan`` produce byte-identical verdict matrices
+   and per-rule counts (host-lane cells oracle-resolved in all three);
+3. kill switch — with ``KTPU_MESH_SHAPE`` deleted the scan reproduces
+   the 1D baseline bit-for-bit;
+4. partition invariants — the KT305 battery
+   (analysis.check_policy_shards) is clean, and a single-policy churn
+   step reassembles exactly one shard while parity holds.
+
+Fast by construction: CPU backend, a dozen policies, a few dozen rows.
+Exit 0 = parity, 1 = divergence.
+"""
+
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force exactly 4 virtual devices even when the caller (e.g. the pytest
+# conftest running ci_lint.sh) already pinned a different count — the
+# assertions below hard-code the (2, 2) geometry
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ.pop("KTPU_MESH_SHAPE", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "grace": f"{(i * 13) % 400}s"}}
+
+
+def main() -> int:
+    import numpy as np
+
+    from kyverno_tpu.analysis import check_policy_shards
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.models import Verdict
+    from kyverno_tpu.models.engine import IncrementalCompiler
+    from kyverno_tpu.parallel import make_mesh, mesh_from_env, sharded_scan
+    from kyverno_tpu.parallel.mesh import is_2d, parse_mesh_shape
+
+    def policy(name, pattern):
+        return load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": "m", "pattern": pattern},
+            }]},
+        })
+
+    lib = {}
+    for i in range(5):
+        lib[f"weight-{i}"] = policy(f"weight-{i}",
+                                    {"spec": {"weight": f"<={40 + i * 20}"}})
+        lib[f"grace-{i}"] = policy(f"grace-{i}",
+                                   {"spec": {"grace": f"<{i + 1}h"}})
+    lib["no-latest"] = policy(
+        "no-latest", {"spec": {"containers": [{"image": "!*:latest"}]}})
+    # host lane: the variable pattern escapes the device lattice
+    lib["self-name"] = policy(
+        "self-name",
+        {"metadata": {"name": "{{request.object.metadata.name}}"}})
+    docs = [_pod(i) for i in range(37)]     # ragged vs every mesh multiple
+
+    # geometry grammar
+    if parse_mesh_shape("", 4) is not None or \
+            parse_mesh_shape("1d", 4) is not None:
+        print("mesh_smoke: unset/'1d' must select the 1D mesh",
+              file=sys.stderr)
+        return 1
+    if parse_mesh_shape("auto", 4) != (2, 2) or \
+            parse_mesh_shape("2x2", 4) != (2, 2):
+        print("mesh_smoke: auto/2x2 on 4 devices must factor to (2, 2)",
+              file=sys.stderr)
+        return 1
+
+    inc = IncrementalCompiler()
+    cps = inc.refresh(list(lib.values()))
+    if not np.asarray(cps.tensors.rule_host_only).any():
+        print("mesh_smoke: corpus lost its host-lane rule", file=sys.stderr)
+        return 1
+    want = np.asarray(cps.evaluate(docs))
+
+    # 1D baseline: switch unset -> make_mesh() is the historical mesh
+    if mesh_from_env() is not None:
+        print("mesh_smoke: mesh_from_env must be None while the switch "
+              "is unset", file=sys.stderr)
+        return 1
+    mesh1 = make_mesh()
+    if is_2d(mesh1):
+        print("mesh_smoke: default make_mesh() must stay 1D",
+              file=sys.stderr)
+        return 1
+    v1, f1, p1 = sharded_scan(cps, docs, mesh1)
+    if not np.array_equal(v1, want):
+        print("mesh_smoke: 1D scan DIVERGES from unsharded evaluate",
+              file=sys.stderr)
+        return 1
+
+    # 2D: env-selected geometry, sharded policy set, verdict parity
+    os.environ["KTPU_MESH_SHAPE"] = "2x2"
+    try:
+        mesh2 = mesh_from_env()
+        if mesh2 is None or not is_2d(mesh2) or \
+                tuple(mesh2.devices.shape) != (2, 2):
+            print("mesh_smoke: KTPU_MESH_SHAPE=2x2 did not build the "
+                  "(2, 2) mesh", file=sys.stderr)
+            return 1
+        sps = inc.refresh_sharded(list(lib.values()), 2)
+        v2, f2, p2 = sharded_scan(sps, docs, mesh2)
+    finally:
+        del os.environ["KTPU_MESH_SHAPE"]
+    if not (np.array_equal(v2, want) and v2.dtype == v1.dtype):
+        print("mesh_smoke: 2D scan DIVERGES from unsharded evaluate",
+              file=sys.stderr)
+        return 1
+    if not (np.array_equal(f1, f2) and np.array_equal(p1, p2)):
+        print("mesh_smoke: 2D per-rule counts DIVERGE from 1D",
+              file=sys.stderr)
+        return 1
+    if (v2 == Verdict.HOST).any():
+        print("mesh_smoke: 2D scan left unresolved HOST cells",
+              file=sys.stderr)
+        return 1
+
+    # partition invariants (KT305) + footprint sanity
+    diags = check_policy_shards(
+        sps.full.tensors,
+        [(sh.cps.tensors, sh.col_map) for sh in sps.shards])
+    if diags:
+        print(f"mesh_smoke: KT305 battery found {len(diags)} violations "
+              f"(first: {diags[0].code} {diags[0].message})",
+              file=sys.stderr)
+        return 1
+    counts = sps.shard_rule_counts()
+    if sum(counts.values()) != sps.full.tensors.n_rules_live or \
+            max(counts.values()) >= sps.full.tensors.n_rules_live:
+        print(f"mesh_smoke: shard rule counts {counts} do not partition "
+              f"{sps.full.tensors.n_rules_live} live rules",
+              file=sys.stderr)
+        return 1
+
+    # churn: replacing one policy must reassemble exactly one shard and
+    # keep parity
+    lib["no-latest"] = policy(
+        "no-latest",
+        {"spec": {"containers": [{"image": "!*:latest", "name": "c?*"}]}})
+    sps = inc.refresh_sharded(list(lib.values()), 2, sharded=sps)
+    if sps.last_refresh["shards_reassembled"] != 1:
+        print(f"mesh_smoke: churn reassembled "
+              f"{sps.last_refresh['shards_reassembled']} shards, want 1",
+              file=sys.stderr)
+        return 1
+    want2 = np.asarray(sps.full.evaluate(docs))
+    os.environ["KTPU_MESH_SHAPE"] = "2x2"
+    try:
+        v3, _, _ = sharded_scan(sps, docs, mesh_from_env())
+    finally:
+        del os.environ["KTPU_MESH_SHAPE"]
+    if not np.array_equal(v3, want2):
+        print("mesh_smoke: post-churn 2D scan DIVERGES", file=sys.stderr)
+        return 1
+
+    # kill switch: with the env var gone the scan is the 1D baseline
+    # bit-for-bit (same mesh geometry, same bytes)
+    killed = make_mesh()
+    if is_2d(killed):
+        print("mesh_smoke: kill switch did not restore the 1D mesh",
+              file=sys.stderr)
+        return 1
+    vk, fk, pk = sharded_scan(cps, docs, killed)
+    if not (np.array_equal(vk, v1) and vk.dtype == v1.dtype
+            and np.array_equal(fk, f1) and np.array_equal(pk, p1)):
+        print("mesh_smoke: kill-switch scan is not the 1D baseline "
+              "bit-for-bit", file=sys.stderr)
+        return 1
+
+    print(f"mesh_smoke: OK ({len(docs)} rows x {len(lib)} policies, "
+          f"shards {counts}, 1D/2D/unsharded verdicts identical, "
+          "KT305 clean, churn reassembled 1 shard, kill switch exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,12 @@ from .diagnostics import (
     parse_suppressions,
 )
 from .featurelint import scan_tree
-from .invariants import check_batch, check_padded, check_tensors
+from .invariants import (
+    check_batch,
+    check_padded,
+    check_policy_shards,
+    check_tensors,
+)
 
 __all__ = [
     "CODES",
@@ -42,6 +47,7 @@ __all__ = [
     "certify_tensors",
     "check_batch",
     "check_padded",
+    "check_policy_shards",
     "check_tensors",
     "lint_batch",
     "parse_suppressions",

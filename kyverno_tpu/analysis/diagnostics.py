@@ -48,6 +48,7 @@ CODES: dict[str, tuple[Severity, str]] = {
     "KT302": (Severity.ERROR, "tensor index out of range"),
     "KT303": (Severity.ERROR, "tensor geometry invariant violated"),
     "KT304": (Severity.ERROR, "segment splice invariant violated"),
+    "KT305": (Severity.ERROR, "policy-shard partition invariant violated"),
     "KT311": (Severity.ERROR, "batch interner index out of range"),
     "KT312": (Severity.ERROR, "batch lane invariant violated"),
     "KT313": (Severity.ERROR, "padding-bucket invariant violated"),

@@ -13,10 +13,12 @@ Pure numpy; no jax import, so the lint CLI stays host-only.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from ..models.compiler import MAX_SEGMENTS, NFA_STATES, PolicyTensors
-from ..models.flatten import T_ABSENT, T_LIST, FlatBatch
+from ..models.flatten import T_ABSENT, T_LIST, FlatBatch, pad_fill
 from ..models.ir import SEP
 from .diagnostics import Diagnostic, make
 
@@ -213,6 +215,117 @@ def check_segments(t: PolicyTensors) -> list[Diagnostic]:
                            *axf, sentinel=-1)
         out += _span_bound("axf_rule", t.axf_rule[axf[0]:axf[1]], s.name,
                            *r)
+    return out
+
+
+def check_policy_shards(full: PolicyTensors, shards) -> list[Diagnostic]:
+    """Policy-shard partition invariants (KT305). ``shards`` is the 2D
+    mesh's policy axis: ``(shard_tensors, col_map)`` pairs where
+    ``col_map[r]`` is shard rule ``r``'s global verdict column in the
+    FULL assembly's layout. The partition is sound only when every
+    shard is internally valid (the KT30x battery over its own rebased
+    tensors), the col_maps exactly tile ``[0, full.n_rules_live)`` — a
+    gap silently drops a rule's verdicts, an overlap double-writes a
+    column — shard rule rows agree with the full assembly's rows at
+    their mapped columns, and shard bucket-padding rows are inert
+    (PAD_FILL kinds, every flag clear, nothing references them) so a
+    padded shard can never emit a phantom verdict."""
+    out: list[Diagnostic] = []
+    n_live = full.n_rules_live
+
+    def _shard(i: int, diags: list[Diagnostic]) -> list[Diagnostic]:
+        return [dataclasses.replace(
+            d, component=f"shard[{i}].{d.component}" if d.component
+            else f"shard[{i}]") for d in diags]
+
+    flag_fields = (
+        "rule_host_only", "rule_match_all_kinds", "rule_match_any",
+        "rule_has_match", "rule_has_exclude", "rule_exclude_all",
+        "rule_has_precond", "rule_precond_any", "rule_is_deny",
+        "rule_deny_any",
+    )
+    kind_pad = pad_fill("kind_id")
+    cols_seen: list[np.ndarray] = []
+    for i, (st, col_map) in enumerate(shards):
+        out += _shard(i, check_tensors(st))
+        live = st.n_rules_live
+        cm = np.asarray(col_map)
+        if not np.issubdtype(cm.dtype, np.integer):
+            out.append(make(
+                "KT305", f"shard {i} col_map dtype {cm.dtype} is not "
+                "integral; the verdict scatter would fancy-index wrong",
+                component=f"shard[{i}].col_map"))
+            continue
+        if cm.size != live:
+            out.append(make(
+                "KT305", f"shard {i} col_map has {cm.size} columns for "
+                f"{live} live rules; scatter and verdicts desynchronized",
+                component=f"shard[{i}].col_map"))
+            continue
+        if cm.size and ((cm < 0) | (cm >= n_live)).any():
+            out.append(make(
+                "KT305", f"shard {i} col_map escapes [0, {n_live}); the "
+                "scatter would write outside the live verdict columns",
+                component=f"shard[{i}].col_map"))
+            continue
+        cols_seen.append(cm)
+
+        # row parity at the mapped columns: the shard's local rule rows
+        # must be the full assembly's rows, just relocated
+        for name in flag_fields:
+            sv = np.asarray(getattr(st, name))[:live]
+            fv = np.asarray(getattr(full, name))[cm]
+            if not np.array_equal(sv, fv):
+                out.append(make(
+                    "KT305", f"shard {i} {name} disagrees with the full "
+                    "assembly at its mapped columns; the partitioner "
+                    "spliced a stale segment",
+                    component=f"shard[{i}].{name}"))
+        # kind-id sets compared as sets: KMAX widths differ per assembly
+        sk, fk = np.asarray(st.rule_kind_ids), np.asarray(full.rule_kind_ids)
+        for r in range(live):
+            if (set(sk[r].tolist()) - {kind_pad}
+                    != set(fk[cm[r]].tolist()) - {kind_pad}):
+                out.append(make(
+                    "KT305", f"shard {i} rule {r} kind set differs from "
+                    f"full column {int(cm[r])}; kind prefilter diverges",
+                    component=f"shard[{i}].rule_kind_ids"))
+                break
+
+        # bucket-padding rows must be inert
+        if st.n_rules > live:
+            if (np.asarray(st.rule_kind_ids)[live:] != kind_pad).any():
+                out.append(make(
+                    "KT305", f"shard {i} pad rows carry kind ids (expected "
+                    f"PAD_FILL {kind_pad}); the kind prefilter could light "
+                    "a dead column", component=f"shard[{i}].rule_kind_ids"))
+            for name in flag_fields:
+                if np.asarray(getattr(st, name))[live:].any():
+                    out.append(make(
+                        "KT305", f"shard {i} pad rows set {name}; padding "
+                        "must be flag-clear",
+                        component=f"shard[{i}].{name}"))
+            for name in ("chk_rule", "alt_rule", "ax_rule", "axg_rule",
+                         "axf_rule"):
+                a = np.asarray(getattr(st, name))
+                if a.size and (a >= live).any():
+                    out.append(make(
+                        "KT305", f"shard {i} {name} references bucket-pad "
+                        f"rule rows (>= {live}); a pad column would "
+                        "receive real verdict writes",
+                        component=f"shard[{i}].{name}"))
+
+    # the union of col_maps must tile the live columns exactly
+    union = (np.sort(np.concatenate(cols_seen)) if cols_seen
+             else np.zeros(0, np.int64))
+    if len(shards) and not np.array_equal(union, np.arange(n_live)):
+        uniq = np.unique(union)
+        missing = n_live - uniq.size
+        out.append(make(
+            "KT305", f"shard col_maps do not tile [0, {n_live}): "
+            f"{missing} columns unowned, {union.size - uniq.size} owned "
+            "twice; the merged verdict matrix is not the unsharded one",
+            component="shards.col_map"))
     return out
 
 

@@ -31,7 +31,7 @@ the north star (BASELINE.json) — the TPU analogue of
 from __future__ import annotations
 
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 
 import numpy as np
 
@@ -855,6 +855,21 @@ def assemble_tensors(segments: list[PolicySegment],
         n_rules_logical=n_rules_logical,
         segments=spans,
     )
+
+
+def tensor_nbytes(t: PolicyTensors) -> int:
+    """Device-resident footprint of one PolicyTensors: the sum of every
+    numpy array the eval kernels close over (dictionary paths and python
+    metadata excluded — they never leave the host). This is the
+    denominator of the 2D mesh's per-device memory headroom report: a
+    policy shard's nbytes over the full set's nbytes ~ 1/policy_shards
+    plus rule-bucket padding."""
+    total = 0
+    for f in fields(t):
+        v = getattr(t, f.name)
+        if isinstance(v, np.ndarray):
+            total += v.nbytes
+    return total
 
 
 def compile_tensors(rule_irs: list[RuleIR]) -> PolicyTensors:

@@ -881,6 +881,27 @@ def build_eval_fn_packed(tensors: PolicyTensors, jit: bool = True):
     return jax.jit(evaluate_packed) if jit else evaluate_packed
 
 
+def build_eval_fn_live(tensors: PolicyTensors, jit: bool = True):
+    """Shard-local eval geometry: :func:`build_eval_fn_packed` with the
+    verdict sliced to the live rule prefix *on device*. A policy shard's
+    rule axis pads to a power-of-two bucket (assemble_tensors
+    rule_bucket); with P shards in flight the inert columns would
+    otherwise transfer P times per chunk, so the 2D mesh path
+    (parallel/mesh.py) slices them off before the gather. The batch's
+    path axis may be wider than this tensor set's dictionary snapshot —
+    ids are append-only-global, every gather stays in bounds."""
+    from ..models.flatten import unpack_batch
+
+    base = build_eval_fn(tensors, jit=False)
+    live = tensors.n_rules_live
+
+    def evaluate_live(cells, bmeta, str_bytes, dictv):
+        v = base(*unpack_batch(cells, bmeta, str_bytes, dictv, xp=jnp))
+        return v[:, :live]
+
+    return jax.jit(evaluate_live) if jit else evaluate_live
+
+
 def _split_blob(blob, B: int, P: int, E: int, V: int):
     """Slice one uint32 transfer buffer (FlatBatch.packed_blob) back into
     (cells, bmeta, str_bytes, dictv). The string bytes travel as uint32

@@ -1,5 +1,14 @@
 """Mesh sharding of the policy x resource evaluation matrix."""
 
-from .mesh import make_mesh, pad_batch, sharded_eval_fn, sharded_scan
+from .mesh import (
+    make_mesh,
+    mesh_from_env,
+    pad_batch,
+    parse_mesh_shape,
+    shard_eval_fns,
+    sharded_eval_fn,
+    sharded_scan,
+)
 
-__all__ = ["make_mesh", "pad_batch", "sharded_eval_fn", "sharded_scan"]
+__all__ = ["make_mesh", "mesh_from_env", "pad_batch", "parse_mesh_shape",
+           "shard_eval_fns", "sharded_eval_fn", "sharded_scan"]

@@ -1,12 +1,19 @@
-"""Data-parallel sharding of the verdict matrix over a device mesh.
+"""Sharding of the policy x resource evaluation matrix over a device mesh.
 
 The reference scales by running one Go process per replica and letting the
 API server fan admission requests out (SURVEY.md section 2.7). Here the
-equivalent axis is the *resource batch*: flattened resource tensors shard
-over the mesh's ``data`` axis, every device holds the (small, replicated)
-policy tensors, and the only cross-device traffic is the verdict-count
-all-reduce for report aggregation — a psum over ICI, the TPU analogue of
-the ReportChangeRequest fan-in (/root/reference/pkg/policyreport).
+batch axis has the same role — flattened resource tensors shard over the
+mesh's ``data`` axis — and, since PR 14, the *rule* axis can shard too:
+``KTPU_MESH_SHAPE=PxD`` arranges the devices as a 2D ``(policy, data)``
+grid. Each of the P policy shards holds only its own segment-aligned
+slice of the policy tensors (models/engine.ShardedPolicySet packs
+IncrementalCompiler segments into per-shard rule buckets over the shared
+dictionary), evaluates the same flattened batch sharded over its row's D
+devices, and the verdict columns gather back into the host rule layout —
+so sharded_scan callers, the batcher's device lane, and host-lane cell
+indexing see bit-identical matrices whatever the geometry. With the
+switch unset the historical 1D data mesh (policy tensors replicated on
+every device) is reproduced exactly.
 """
 
 from __future__ import annotations
@@ -19,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.engine import CompiledPolicySet
+from ..models.engine import CompiledPolicySet, ShardedPolicySet
 from ..models.flatten import (
     BATCH_ARRAYS,
     FlatBatch,
@@ -28,19 +35,105 @@ from ..models.flatten import (
     unpack_batch,
 )
 from ..ops.eval import V_FAIL, V_HOST, V_PASS
+from ..runtime import featureplane
+
+MESH_AXIS_POLICY = "policy"
 
 
-def make_mesh(devices=None, axis: str = "data") -> Mesh:
-    devices = devices if devices is not None else jax.devices()
+def parse_mesh_shape(spec: str, n_devices: int) -> tuple[int, int] | None:
+    """``KTPU_MESH_SHAPE`` grammar -> 2D ``(policy, data)`` shape or None
+    for the 1D default. ``""``/``"1"``/``"1d"`` select 1D; ``"auto"``
+    factors the device count (largest power-of-two policy axis p with
+    p*p <= n); ``"PxD"`` is explicit and must multiply out to the device
+    count."""
+    spec = (spec or "").strip().lower()
+    if spec in ("", "1", "1d"):
+        return None
+    if spec == "auto":
+        p = 1
+        while p * 2 * p * 2 <= n_devices and n_devices % (p * 2) == 0:
+            p *= 2
+        return (p, n_devices // p)
+    try:
+        ps, ds = spec.split("x")
+        shape = (int(ps), int(ds))
+    except ValueError:
+        raise ValueError(
+            f"KTPU_MESH_SHAPE={spec!r} is not 'PxD', 'auto' or '1d'")
+    if shape[0] < 1 or shape[1] < 1:
+        raise ValueError(f"KTPU_MESH_SHAPE={spec!r}: axes must be >= 1")
+    if shape[0] * shape[1] != n_devices:
+        raise ValueError(
+            f"KTPU_MESH_SHAPE={spec!r} needs {shape[0] * shape[1]} devices "
+            f"but {n_devices} are visible")
+    return shape
+
+
+def mesh_shape_from_env(n_devices: int) -> tuple[int, int] | None:
+    return parse_mesh_shape(featureplane.raw("KTPU_MESH_SHAPE"), n_devices)
+
+
+def is_2d(mesh: Mesh) -> bool:
+    return MESH_AXIS_POLICY in mesh.axis_names
+
+
+def policy_axis_size(mesh: Mesh) -> int:
+    return (mesh.devices.shape[list(mesh.axis_names)
+                               .index(MESH_AXIS_POLICY)]
+            if is_2d(mesh) else 1)
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Devices along the batch axis — the padding multiple for the flat
+    batch (the 1D mesh shards the batch over every device; a 2D mesh
+    only over its data columns)."""
+    return int(mesh.devices.shape[-1]) if is_2d(mesh) else int(
+        mesh.devices.size)
+
+
+def make_mesh(devices=None, axis: str = "data",
+              shape: tuple[int, int] | None = None) -> Mesh:
+    """Build the scan mesh. ``shape=None`` consults ``KTPU_MESH_SHAPE``:
+    unset keeps the historical 1D ``(data,)`` mesh bit-for-bit, ``PxD``
+    (or ``auto``) arranges the same devices as a 2D
+    ``(policy, data)`` grid. An explicit ``shape`` tuple overrides the
+    environment."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = mesh_shape_from_env(len(devices))
     try:
         from ..runtime import metrics as metrics_mod
 
-        metrics_mod.record_mesh_devices(metrics_mod.registry(),
-                                        len(devices),
+        reg = metrics_mod.registry()
+        metrics_mod.record_mesh_devices(reg, len(devices),
                                         devices[0].platform)
+        if shape is None:
+            metrics_mod.record_mesh_shape(reg, (axis,), (len(devices),))
+        else:
+            metrics_mod.record_mesh_shape(
+                reg, (MESH_AXIS_POLICY, axis), shape)
     except Exception:
         pass
-    return Mesh(np.array(devices), (axis,))
+    if shape is None:
+        return Mesh(np.array(devices), (axis,))
+    p, d = shape
+    if p * d != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {p * d} devices, "
+                         f"got {len(devices)}")
+    return Mesh(np.array(devices).reshape(p, d), (MESH_AXIS_POLICY, axis))
+
+
+def mesh_from_env(devices=None) -> Mesh | None:
+    """Mesh selection plumbing for the runtime planes (BackgroundScanner,
+    AdmissionBatcher stats): a Mesh when ``KTPU_MESH_SHAPE`` explicitly
+    selects one (``1d`` gives the 1D mesh over all devices), else None —
+    the caller keeps its single-device path, which is the historical
+    behavior when the switch is unset."""
+    if not featureplane.raw("KTPU_MESH_SHAPE").strip():
+        return None
+    devices = list(devices if devices is not None else jax.devices())
+    return make_mesh(devices,
+                     shape=mesh_shape_from_env(len(devices)))
 
 
 def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
@@ -64,11 +157,33 @@ def pad_batch(batch: FlatBatch, multiple: int) -> tuple[FlatBatch, int]:
     return replace(batch, **updates), b
 
 
+def _batch_multiple(mesh: Mesh) -> int:
+    """The flat-batch padding multiple for this mesh, validated once per
+    scan (not recomputed per chunk inside the worker loop): every chunk
+    pads its batch axis to a multiple of the data-axis device count so
+    GSPMD can split it evenly."""
+    multiple = data_axis_size(mesh)
+    if multiple < 1 or mesh.devices.size % multiple:
+        raise ValueError(
+            f"mesh {tuple(mesh.devices.shape)} has no even data split "
+            f"(data axis {multiple})")
+    return multiple
+
+
 def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
     """jit the verdict computation over the packed transfer form with the
     batch axis sharded over the mesh; XLA partitions the whole dataflow
     (GSPMD), no collectives needed until the count reduction. The packed
-    cells/bmeta shard over ``axis``; the string dictionary replicates."""
+    cells/bmeta shard over ``axis``; the string dictionary replicates.
+
+    1D meshes only — a 2D ``(policy, data)`` mesh needs per-shard
+    programs (the policy tensors are jaxpr constants, so the policy axis
+    partitions across *programs*, one per shard row): see
+    :func:`shard_eval_fns` / :func:`sharded_scan`."""
+    if is_2d(mesh):
+        raise ValueError("sharded_eval_fn is the 1D program; use "
+                         "shard_eval_fns(ShardedPolicySet, mesh) for a "
+                         "2D (policy, data) mesh")
     from ..ops.eval import build_eval_fn
 
     base = build_eval_fn(cps.tensors, jit=False)
@@ -90,10 +205,61 @@ def sharded_eval_fn(cps: CompiledPolicySet, mesh: Mesh, axis: str = "data"):
     )
 
 
+def shard_eval_fns(sps: ShardedPolicySet, mesh: Mesh, axis: str = "data"):
+    """Per-policy-shard pjit programs for a 2D ``(policy, data)`` mesh.
+
+    Row ``p`` of the device grid evaluates shard ``p``'s tensors — the
+    only copy of those rules anywhere on the mesh — with the flat batch
+    sharded over the row's data devices and the (small) string
+    dictionary replicated within the row. Verdicts come back already
+    sliced to the shard's live rules (ops/eval.build_eval_fn_live), so
+    the gather moves exactly the columns the host layout needs.
+
+    Returns ``[(PolicyShard, fn), ...]``. Programs cache on the shard
+    object keyed by the row's device ids: a shard the partitioner didn't
+    touch across a refresh keeps its compiled XLA executable."""
+    if not is_2d(mesh):
+        raise ValueError("shard_eval_fns needs a 2D (policy, data) mesh")
+    from ..ops.eval import build_eval_fn_live
+
+    rows = np.asarray(mesh.devices)
+    n_rows = rows.shape[0]
+    if sps.n_shards != n_rows:
+        raise ValueError(
+            f"ShardedPolicySet has {sps.n_shards} shards but the mesh "
+            f"policy axis is {n_rows}")
+    out = []
+    for shard in sps.shards:
+        row = list(rows[shard.index])
+        key = (axis, tuple(d.id for d in row))
+        fn = shard._mesh_fn_cache.get(key)
+        if fn is None:
+            sub = Mesh(np.array(row), (axis,))
+            data = NamedSharding(sub, P(axis))
+            repl = NamedSharding(sub, P())
+            base = build_eval_fn_live(shard.cps.tensors, jit=False)
+
+            def step(cells, bmeta, str_bytes, dictv, _base=base):
+                # build_eval_fn_live consumes the packed transfer form
+                # directly (it unpacks on device) and returns verdicts
+                # already sliced to the shard's live rules
+                verdict = _base(cells, bmeta, str_bytes, dictv)
+                fails = jnp.sum(verdict == V_FAIL, axis=0)
+                passes = jnp.sum(verdict == V_PASS, axis=0)
+                return verdict, fails, passes
+
+            fn = jax.jit(step,
+                         in_shardings=(data, data, repl, repl),
+                         out_shardings=(data, repl, repl))
+            shard._mesh_fn_cache[key] = fn
+        out.append((shard, fn))
+    return out
+
+
 DEFAULT_CHUNK = 65_536  # scan chunk size: bounds flatten + device memory
 
 
-def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
+def sharded_scan(cps, resources: list[dict], mesh: Mesh,
                  axis: str = "data", chunk_size: int = DEFAULT_CHUNK,
                  flatten_workers: int = 6):
     """Background-scan entry: flatten, pad to the mesh, evaluate sharded.
@@ -101,9 +267,19 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     Returns (verdicts [B, R] numpy, fails [R], passes [R]) — the mesh-scale
     replay of /root/reference/pkg/policy/existing.go:20
     processExistingResources. The per-rule counts come from the on-device
-    psum of sharded_eval_fn; host-lane cells (Verdict.HOST) resolve
+    psum of the eval program; host-lane cells (Verdict.HOST) resolve
     through the CPU oracle exactly like CompiledPolicySet.evaluate, so
     precondition/context rules are reported, not dropped.
+
+    On a 1D mesh ``cps`` is a CompiledPolicySet and every device holds
+    the full (replicated) policy tensors. On a 2D ``(policy, data)``
+    mesh ``cps`` should be a models/engine.ShardedPolicySet — each
+    policy shard's tensors live only on its row of devices, every row
+    scores the same batch chunks, and the shard verdict columns scatter
+    back into the host rule layout (bit-identical to the 1D result). A
+    plain CompiledPolicySet passed with a 2D mesh is wrapped on the fly
+    (full recompile — long-lived callers should hold the
+    ShardedPolicySet themselves).
 
     Host-cell resolution is per-chunk, inside the chunk's own worker
     thread: each worker starts a host-lane prefetch for its chunk's
@@ -126,8 +302,21 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
     from ..runtime import tracing
     from ..runtime.hostlane import resolver
 
+    if is_2d(mesh):
+        if isinstance(cps, ShardedPolicySet):
+            sps = cps
+        else:
+            sps = ShardedPolicySet(
+                policy_axis_size(mesh)).refresh(cps.policies)
+        return _sharded_scan_2d(sps, resources, mesh, axis, chunk_size,
+                                flatten_workers)
+
     fn = sharded_eval_fn(cps, mesh, axis)
     rec = tracing.recorder()
+
+    # the padding multiple is a property of the mesh, not the chunk:
+    # validate it once here instead of recomputing per chunk below
+    multiple = _batch_multiple(mesh)
 
     n_live = cps.tensors.n_rules_live
     has_host_rules = bool(
@@ -141,8 +330,7 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
         try:
             f0 = time.perf_counter()
             pb = cps.flatten_packed(chunk)
-            cells, bmeta, n = pad_packed(pb.cells, pb.bmeta,
-                                         mesh.devices.size)
+            cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, multiple)
             rec.add_span(tr, "flatten", f0, time.perf_counter(),
                          rows=len(chunk), lane="worker")
             # dispatch first, then start this chunk's host prefetch: the
@@ -187,6 +375,13 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
                 tracing.unbind(tok)
             rec.finish(tr)
 
+    return _run_chunks(eval_chunk, resources, chunk_size, flatten_workers)
+
+
+def _run_chunks(eval_chunk, resources: list[dict], chunk_size: int,
+                flatten_workers: int):
+    """Shared chunk pipeline for both mesh geometries: one chunk inline,
+    otherwise the bounded flatten/dispatch worker pool."""
     if len(resources) <= chunk_size:
         verdicts, fails, passes = eval_chunk(resources)
     else:
@@ -200,3 +395,80 @@ def sharded_scan(cps: CompiledPolicySet, resources: list[dict], mesh: Mesh,
         fails = np.sum([f for _, f, _ in outs], axis=0)
         passes = np.sum([p for _, _, p in outs], axis=0)
     return verdicts, np.asarray(fails), np.asarray(passes)
+
+
+def _sharded_scan_2d(sps: ShardedPolicySet, resources: list[dict],
+                     mesh: Mesh, axis: str, chunk_size: int,
+                     flatten_workers: int):
+    """2D scan body: one flatten per chunk against the full dictionary,
+    every policy-shard program dispatched (async) against the same
+    padded batch, shard verdict columns scattered back into the host
+    rule layout, then the ordinary host-lane post-pass over the full
+    set. Counts reduce on device per shard and scatter with the same
+    column maps."""
+    from ..runtime import tracing
+    from ..runtime.hostlane import resolver
+
+    full = sps.full
+    fns = shard_eval_fns(sps, mesh, axis)
+    rec = tracing.recorder()
+    multiple = _batch_multiple(mesh)
+    n_live = full.tensors.n_rules_live
+    has_host_rules = bool(
+        np.asarray(full.tensors.rule_host_only[:n_live]).any())
+
+    def eval_chunk(chunk: list[dict]):
+        tr = rec.start("scan_chunk", rows=len(chunk), lane="mesh2d")
+        tok = tracing.bind(tr) if tr is not None else None
+        try:
+            f0 = time.perf_counter()
+            pb = full.flatten_packed(chunk)
+            cells, bmeta, n = pad_packed(pb.cells, pb.bmeta, multiple)
+            rec.add_span(tr, "flatten", f0, time.perf_counter(),
+                         rows=len(chunk), lane="worker")
+            d0 = time.perf_counter()
+            # dispatch every shard before materializing any: the P rows
+            # evaluate their rule slices concurrently
+            outs = [(shard, fn(cells, bmeta, pb.str_bytes, pb.dictv))
+                    for shard, fn in fns]
+            pf = (resolver().prefetch(full, chunk)
+                  if has_host_rules else None)
+            v = np.full((n, n_live), 0, dtype=np.int8)  # NOT_APPLICABLE
+            fails = np.zeros(n_live, dtype=np.int64)
+            passes = np.zeros(n_live, dtype=np.int64)
+            for shard, (sv, sf, sp) in outs:
+                cols = shard.col_map
+                v[:, cols] = np.array(sv)[:n]
+                fails[cols] = np.array(sf).astype(np.int64)
+                passes[cols] = np.array(sp).astype(np.int64)
+            rec.add_span(tr, "device_dispatch", d0, time.perf_counter(),
+                         lane="mesh2d", rows=len(chunk),
+                         shards=len(fns))
+            host = v == V_HOST
+            if host.any() or pf is not None:
+                h0 = time.perf_counter()
+                bb, rr = np.nonzero(host)
+                full.resolve_host_cells(chunk, v, prefetch=pf)
+                if bb.size:
+                    vals = v[bb, rr]
+                    np.add.at(fails, rr[vals == V_FAIL], 1)
+                    np.add.at(passes, rr[vals == V_PASS], 1)
+                rec.add_span(tr, "host_resolve", h0, time.perf_counter(),
+                             cells=int(bb.size),
+                             lane=("prefetch" if pf is not None
+                                   else "post_pass"))
+            try:
+                from ..runtime import metrics as metrics_mod
+
+                metrics_mod.record_policy_verdict_matrix(
+                    metrics_mod.registry(), full.rule_refs, v,
+                    lane="mesh")
+            except Exception:
+                pass
+            return v, fails, passes
+        finally:
+            if tok is not None:
+                tracing.unbind(tok)
+            rec.finish(tr)
+
+    return _run_chunks(eval_chunk, resources, chunk_size, flatten_workers)

@@ -89,6 +89,18 @@ class BackgroundScanner:
                  report_gen: ReportGenerator | None = None, mesh=None):
         self.client = client
         self.report_gen = report_gen
+        if mesh is None:
+            # mesh selection plumbing: KTPU_MESH_SHAPE picks the scan
+            # geometry for callers that don't pass a mesh explicitly.
+            # Unset (the default) keeps the historical single-device
+            # path bit-for-bit — the env read gates the jax-importing
+            # mesh build.
+            from . import featureplane
+
+            if featureplane.raw("KTPU_MESH_SHAPE").strip():
+                from ..parallel.mesh import mesh_from_env
+
+                mesh = mesh_from_env()
         self.mesh = mesh
         self.resource_manager = ResourceManager()
         from ..models.compiler import incremental_enabled
@@ -97,6 +109,9 @@ class BackgroundScanner:
             from ..models.engine import IncrementalCompiler
 
             self._inc = IncrementalCompiler()
+        # 2D (policy, data) mesh: the policy-axis decomposition lives
+        # here and refreshes with the population (models/engine)
+        self._sharded = None
         # persisted scan state between passes (delta scanning): row keys
         # in scan order, resource bodies, flatten-row memos, and the
         # verdict matrix as per-(policy, rule) columns — column keying
@@ -135,11 +150,32 @@ class BackgroundScanner:
 
     def _apply_policies(self, policies: list) -> dict:
         self.policies = [p for p in policies if p.spec.background]
+        if self._mesh_is_2d():
+            from ..models.engine import ShardedPolicySet
+            from ..parallel.mesh import policy_axis_size
+
+            if self._sharded is None:
+                # reuse the scanner's IncrementalCompiler so the full
+                # set and the shard slices share one segment cache
+                self._sharded = ShardedPolicySet(
+                    policy_axis_size(self.mesh), compiler=self._inc)
+            self._sharded.refresh(self.policies)
+            self.cps = self._sharded.full
+            info = dict(self._sharded.compiler.last_refresh)
+            info["shards"] = dict(self._sharded.last_refresh)
+            return info
         if self._inc is not None:
             self.cps = self._inc.refresh(self.policies)
             return self._inc.last_refresh
         self.cps = CompiledPolicySet(self.policies)
         return {}
+
+    def _mesh_is_2d(self) -> bool:
+        if self.mesh is None:
+            return False
+        from ..parallel.mesh import is_2d
+
+        return is_2d(self.mesh)
 
     def update_policies(self, policies: list) -> dict:
         """Replace the scanned policy set. With incremental compilation
@@ -211,7 +247,10 @@ class BackgroundScanner:
         if self.mesh is not None:
             from ..parallel import sharded_scan
 
-            verdicts, _, _ = sharded_scan(self.cps, resources, self.mesh)
+            # a 2D mesh scans the policy-axis decomposition (per-shard
+            # tensors); the 1D mesh keeps the replicated full set
+            src = self._sharded if self._sharded is not None else self.cps
+            verdicts, _, _ = sharded_scan(src, resources, self.mesh)
             scan_lane = "mesh"
         elif self._inc is not None:
             # flatten chunk-wise and keep the split rows: the same single

@@ -197,6 +197,13 @@ class AdmissionBatcher:
         self.circuit_cooldown_s = circuit_cooldown_s
         self.stats = {"oracle": 0, "device": 0, "probe": 0,
                       "clean": 0, "attention": 0}
+        # scan-plane mesh geometry, surfaced here so operators reading
+        # batcher stats see which lane shards what: admission flushes
+        # stay on the single-device lane (the verdict layout a 2D
+        # policy-sharded scan plane scatters back into is bit-compatible
+        # with it — ShardedPolicySet.evaluate_device), while the
+        # KTPU_MESH_SHAPE geometry applies to the background scan plane.
+        self.stats["mesh_shape"] = self._mesh_selection()
         # flush-level HOST-cell resolution: cluster-independent host-lane
         # rules (oracle_pool.pool_safe policies) resolve in ONE batched
         # oracle pass per flush instead of per-request full evaluations in
@@ -255,6 +262,15 @@ class AdmissionBatcher:
         self._worker = threading.Thread(target=self._run, name="adm-batch",
                                         daemon=True)
         self._worker.start()
+
+    @staticmethod
+    def _mesh_selection() -> str:
+        """KTPU_MESH_SHAPE selection as a stats string ("1d" when the
+        switch is unset/off). Reads the raw spec rather than resolving
+        a mesh — resolution needs the device inventory (jax), and the
+        batcher must construct cleanly before any device is touched."""
+        spec = featureplane.raw("KTPU_MESH_SHAPE").strip().lower()
+        return spec if spec and spec not in ("1", "1d") else "1d"
 
     # ------------------------------------------------------------ routing
 

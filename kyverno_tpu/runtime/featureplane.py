@@ -120,6 +120,11 @@ REGISTRY: dict[str, Switch] = {s.name: s for s in (
     _S("KTPU_DEFAULT_FAILURE_POLICY", "kyverno_tpu.runtime.webhookconfig",
        "tests/runtime/test_webhookconfig.py", "",
        "failurePolicy when policies don't pin one"),
+    # -- mesh plane (2D policy x data sharding)
+    _S("KTPU_MESH_SHAPE", "kyverno_tpu.parallel.mesh",
+       "deploy/mesh_smoke.py", "",
+       "mesh geometry: unset = 1D data mesh, 'PxD' = 2D policy x data, "
+       "'auto' = factor the device count, '1d' = force 1D"),
     # -- bench driver
     _S("KTPU_BENCH_CONFIGS", "bench",
        "bench.py --smoke", "",

@@ -968,3 +968,48 @@ def record_mesh_devices(registry: MetricsRegistry, count: int,
     rate an operator derives from the scan counters."""
     registry.set_gauge("kyverno_mesh_devices",
                        {"platform": platform_name}, float(count))
+    _MESH_GEOMETRY["devices"] = int(count)
+    _MESH_GEOMETRY["platform"] = str(platform_name)
+
+
+# host-side snapshot of the last-built mesh + policy partition, embedded
+# in /healthz (obs_http) so geometry is visible without scraping gauge
+# label sets — and without /healthz importing jax
+_MESH_GEOMETRY: dict = {"devices": 0, "platform": None, "axes": {},
+                        "shard_rules": {}}
+
+
+def record_mesh_shape(registry: MetricsRegistry, axis_names: tuple,
+                      shape: tuple) -> None:
+    """``kyverno_mesh_shape{axis}`` gauges for the mesh geometry the
+    scan plane selected — a 1D mesh stamps only its data axis, a 2D
+    ``(policy, data)`` mesh stamps both, so the kill-switch position of
+    KTPU_MESH_SHAPE is scrape-visible."""
+    for ax, size in zip(axis_names, shape):
+        registry.set_gauge("kyverno_mesh_shape", {"axis": str(ax)},
+                           float(size))
+    # a geometry change replaces the whole axis map (stale axes from the
+    # previous shape must not linger in the /healthz snapshot)
+    _MESH_GEOMETRY["axes"] = {str(ax): int(size)
+                              for ax, size in zip(axis_names, shape)}
+
+
+def record_mesh_shard_rules(registry: MetricsRegistry,
+                            counts: dict) -> None:
+    """``kyverno_mesh_shard_rules{shard}`` — live rules per policy shard
+    after a ShardedPolicySet refresh. The spread across shards is the
+    partitioner's balance; the max is the per-device rule memory bound."""
+    for shard, n in counts.items():
+        registry.set_gauge("kyverno_mesh_shard_rules",
+                           {"shard": str(shard)}, float(n))
+    _MESH_GEOMETRY["shard_rules"] = {str(k): int(v)
+                                     for k, v in counts.items()}
+
+
+def mesh_geometry_snapshot() -> dict:
+    """The /healthz mesh block: device inventory, selected axes, and the
+    per-shard rule distribution (empty axes = no mesh built yet)."""
+    return {"devices": _MESH_GEOMETRY["devices"],
+            "platform": _MESH_GEOMETRY["platform"],
+            "axes": dict(_MESH_GEOMETRY["axes"]),
+            "shard_rules": dict(_MESH_GEOMETRY["shard_rules"])}

@@ -116,6 +116,9 @@ def handle_obs_get(path: str, registry=None):
             },
             "slo": slo,
             "slo_actions": slo_actions,
+            # scan-plane mesh geometry (PR 14): selected axes, device
+            # inventory, per-shard rule distribution
+            "mesh": metrics_mod.mesh_geometry_snapshot(),
         }).encode()
         return 200, body, "application/json"
     if route == "/debug/policies":

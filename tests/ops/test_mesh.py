@@ -4,9 +4,20 @@ import jax
 import numpy as np
 import pytest
 
-from kyverno_tpu.api.load import load_policies_from_path
+from kyverno_tpu.api.load import load_policies_from_path, load_policy
 from kyverno_tpu.models import CompiledPolicySet, Verdict
-from kyverno_tpu.parallel import make_mesh, sharded_scan
+from kyverno_tpu.parallel import (
+    make_mesh,
+    mesh_from_env,
+    parse_mesh_shape,
+    sharded_scan,
+)
+from kyverno_tpu.parallel.mesh import (
+    data_axis_size,
+    is_2d,
+    policy_axis_size,
+    sharded_eval_fn,
+)
 
 
 @pytest.fixture(scope="module")
@@ -133,3 +144,150 @@ def test_mutate_gate_screen_on_mesh():
     # the mesh path IS the public scan entry — no hand-rolled pipeline
     got, _, _ = sharded_scan(bm._gate_cps, resources, make_mesh())
     np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------- 2D (policy, data)
+
+
+def _mixed_policies():
+    """Synthetic mixed-lane corpus: device globs, numeric bounds, and a
+    host-lane variable pattern — no /root/reference dependency."""
+    def policy(name, pattern):
+        return load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": "m", "pattern": pattern},
+            }]},
+        })
+    out = [policy(f"weight-{i}", {"spec": {"weight": f"<={30 + 20 * i}"}})
+           for i in range(4)]
+    out.append(policy("no-latest",
+                      {"spec": {"containers": [{"image": "!*:latest"}]}}))
+    out.append(policy("self-name",
+                      {"metadata": {
+                          "name": "{{request.object.metadata.name}}"}}))
+    return out
+
+
+def _mixed_pod(i):
+    p = make_pod(i)
+    p["spec"]["weight"] = (i * 17) % 120
+    return p
+
+
+class TestMeshShapeGrammar:
+    def test_unset_and_1d_select_the_1d_mesh(self):
+        assert parse_mesh_shape("", 8) is None
+        assert parse_mesh_shape("1", 8) is None
+        assert parse_mesh_shape("1d", 8) is None
+
+    def test_auto_factors_the_device_count(self):
+        assert parse_mesh_shape("auto", 8) == (2, 4)
+        assert parse_mesh_shape("auto", 4) == (2, 2)
+        assert parse_mesh_shape("auto", 16) == (4, 4)
+        # no even pow2 split: everything stays on the data axis
+        assert parse_mesh_shape("auto", 3) == (1, 3)
+
+    def test_explicit_shape_must_multiply_out(self):
+        assert parse_mesh_shape("2x4", 8) == (2, 4)
+        with pytest.raises(ValueError, match="devices"):
+            parse_mesh_shape("2x2", 8)
+        with pytest.raises(ValueError, match="PxD"):
+            parse_mesh_shape("garbage", 8)
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_mesh_shape("0x8", 8)
+
+
+class TestMakeMesh2D:
+    def test_default_stays_1d(self, monkeypatch):
+        monkeypatch.delenv("KTPU_MESH_SHAPE", raising=False)
+        mesh = make_mesh()
+        assert not is_2d(mesh)
+        assert mesh.axis_names == ("data",)
+        assert policy_axis_size(mesh) == 1
+        assert data_axis_size(mesh) == 8
+        assert mesh_from_env() is None
+
+    def test_env_selects_2d(self, monkeypatch):
+        monkeypatch.setenv("KTPU_MESH_SHAPE", "2x4")
+        mesh = mesh_from_env()
+        assert mesh is not None and is_2d(mesh)
+        assert tuple(mesh.devices.shape) == (2, 4)
+        assert policy_axis_size(mesh) == 2
+        assert data_axis_size(mesh) == 4
+
+    def test_explicit_shape_overrides_env(self, monkeypatch):
+        monkeypatch.delenv("KTPU_MESH_SHAPE", raising=False)
+        mesh = make_mesh(shape=(4, 2))
+        assert tuple(mesh.devices.shape) == (4, 2)
+        assert mesh.axis_names == ("policy", "data")
+
+    def test_1d_program_refuses_2d_mesh(self):
+        cps = CompiledPolicySet(_mixed_policies()[:1])
+        with pytest.raises(ValueError, match="2D"):
+            sharded_eval_fn(cps, make_mesh(shape=(2, 4)))
+
+
+class Test2DScanParity:
+    def test_2d_scan_matches_1d_and_unsharded(self):
+        from kyverno_tpu.models.engine import shard_policies
+
+        policies = _mixed_policies()
+        cps = CompiledPolicySet(policies)
+        resources = [_mixed_pod(i) for i in range(23)]  # ragged
+        want = cps.evaluate(resources)
+
+        v1, f1, p1 = sharded_scan(cps, resources, make_mesh())
+        np.testing.assert_array_equal(v1, want)
+
+        sps = shard_policies(policies, 2)
+        v2, f2, p2 = sharded_scan(sps, resources, make_mesh(shape=(2, 4)))
+        assert v2.dtype == v1.dtype
+        np.testing.assert_array_equal(v2, want)
+        np.testing.assert_array_equal(f2, f1)
+        np.testing.assert_array_equal(p2, p1)
+        assert not (v2 == Verdict.HOST).any()
+
+    def test_plain_cps_wrapped_on_the_fly(self):
+        policies = _mixed_policies()
+        cps = CompiledPolicySet(policies)
+        resources = [_mixed_pod(i) for i in range(9)]
+        got, _, _ = sharded_scan(cps, resources, make_mesh(shape=(4, 2)))
+        np.testing.assert_array_equal(got, cps.evaluate(resources))
+
+    def test_2d_chunked_pipeline_parity(self):
+        from kyverno_tpu.models.engine import shard_policies
+
+        policies = _mixed_policies()
+        sps = shard_policies(policies, 2)
+        resources = [_mixed_pod(i) for i in range(50)]
+        mesh = make_mesh(shape=(2, 4))
+        chunked, cf, cp_ = sharded_scan(sps, resources, mesh, chunk_size=16)
+        whole, wf, wp = sharded_scan(sps, resources, mesh)
+        np.testing.assert_array_equal(chunked, whole)
+        np.testing.assert_array_equal(cf, wf)
+        np.testing.assert_array_equal(cp_, wp)
+
+    def test_mesh_geometry_observable(self):
+        from kyverno_tpu.models.engine import shard_policies
+        from kyverno_tpu.runtime import metrics as metrics_mod
+
+        reg = metrics_mod.registry()
+        make_mesh(shape=(2, 4))
+        assert reg.gauge_value("kyverno_mesh_shape",
+                               {"axis": "policy"}) == 2.0
+        assert reg.gauge_value("kyverno_mesh_shape",
+                               {"axis": "data"}) == 4.0
+        sps = shard_policies(_mixed_policies(), 2)
+        for shard, n in sps.shard_rule_counts().items():
+            assert reg.gauge_value("kyverno_mesh_shard_rules",
+                                   {"shard": str(shard)}) == float(n)
+        snap = metrics_mod.mesh_geometry_snapshot()
+        assert snap["axes"] == {"policy": 2, "data": 4}
+        assert snap["shard_rules"] == {
+            str(k): v for k, v in sps.shard_rule_counts().items()}
+        # a 1D rebuild replaces the axis map (no stale policy axis)
+        make_mesh()
+        assert metrics_mod.mesh_geometry_snapshot()["axes"] == {"data": 8}

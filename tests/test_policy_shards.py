@@ -1,0 +1,211 @@
+"""Policy-axis partitioner (models/engine.ShardedPolicySet) under churn.
+
+The contract that makes the 2D mesh cheap to run continuously: segment
+add/remove/replace must touch exactly one shard — the untouched shards
+keep their CompiledPolicySet *instances* and their tensor bytes stay
+identical (so cached XLA executables survive) — while the merged verdict
+matrix stays bit-identical to the unsharded device lane, and the KT305
+partition battery stays clean at every step.
+"""
+
+import hashlib
+from dataclasses import fields
+
+import numpy as np
+import pytest
+
+from kyverno_tpu.analysis import check_policy_shards
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.models.compiler import PolicyTensors, tensor_nbytes
+from kyverno_tpu.models.engine import (
+    IncrementalCompiler,
+    PolicyPartitioner,
+    ShardedPolicySet,
+    shard_policies,
+)
+
+
+def _policy(name, pattern, n_rules=1):
+    rules = [{
+        "name": f"r{j}", "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "m", "pattern": pattern},
+    } for j in range(n_rules)]
+    return load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": rules},
+    })
+
+
+def _pod(i):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"pod-{i}", "namespace": "default",
+                         "labels": {"idx": str(i)}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": ("nginx:latest" if i % 3 == 0
+                                               else f"nginx:1.{i}")}],
+                     "weight": (i * 7) % 160,
+                     "grace": f"{(i * 13) % 400}s"}}
+
+
+def _lib():
+    return {
+        "no-latest": _policy(
+            "no-latest",
+            {"spec": {"containers": [{"image": "!*:latest"}]}}),
+        "weight-cap": _policy("weight-cap", {"spec": {"weight": "<=100"}}),
+        "grace-cap": _policy("grace-cap", {"spec": {"grace": "<1h"}}),
+        "named": _policy("named", {"metadata": {"name": "pod-?*"}}),
+    }
+
+
+def _tensor_digest(t: PolicyTensors) -> str:
+    h = hashlib.sha256()
+    for f in fields(t):
+        v = getattr(t, f.name)
+        if isinstance(v, np.ndarray):
+            h.update(f.name.encode())
+            h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()
+
+
+def _assert_partition_clean(sps):
+    diags = check_policy_shards(
+        sps.full.tensors,
+        [(sh.cps.tensors, sh.col_map) for sh in sps.shards])
+    assert not diags, [f"{d.code} {d.component}: {d.message}"
+                       for d in diags]
+
+
+def _assert_device_parity(sps, docs):
+    batch = sps.full.flatten(docs)
+    got = sps.evaluate_device(batch)
+    want = sps.full.evaluate_device(batch)
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(got, want)
+
+
+class TestPartitionerPlan:
+    def test_balances_by_rule_count(self):
+        part = PolicyPartitioner(2)
+        assign = part.plan([("a", 8), ("b", 1), ("c", 1), ("d", 1),
+                            ("e", 1), ("f", 1), ("g", 1), ("h", 1)])
+        # the heavy key claims one shard; the light keys pile onto the
+        # other until the loads cross
+        load = [0, 0]
+        for (_, w), s in zip([("a", 8), ("b", 1), ("c", 1), ("d", 1),
+                              ("e", 1), ("f", 1), ("g", 1), ("h", 1)],
+                             assign):
+            load[s] += w
+        assert abs(load[0] - load[1]) <= 8
+
+    def test_sticky_across_churn(self):
+        part = PolicyPartitioner(3)
+        first = part.plan([(k, 2) for k in "abcdef"])
+        # removing one key and adding two must not move survivors
+        second = part.plan([(k, 2) for k in "abcde"] + [("x", 2), ("y", 2)])
+        for key, s in zip("abcde", second):
+            assert s == first["abcdef".index(key)]
+
+    def test_dead_keys_free_their_weight(self):
+        part = PolicyPartitioner(2)
+        part.plan([("a", 10), ("b", 1)])
+        # "a" dies; a new heavy key must land on the now-empty shard
+        assign = part.plan([("b", 1), ("c", 10)])
+        assert assign[0] != assign[1]
+
+    def test_rejects_zero_shards(self):
+        with pytest.raises(ValueError):
+            PolicyPartitioner(0)
+
+
+class TestShardedPolicySetChurn:
+    def test_add_remove_replace_touch_one_shard(self):
+        lib = _lib()
+        docs = [_pod(i) for i in range(24)]
+        inc = IncrementalCompiler()
+        sps = inc.refresh_sharded(list(lib.values()), 2)
+        _assert_partition_clean(sps)
+        _assert_device_parity(sps, docs)
+
+        def snapshot():
+            return {sh.index: (sh.cps, _tensor_digest(sh.cps.tensors))
+                    for sh in sps.shards}
+
+        def assert_one_shard_changed(before):
+            after = snapshot()
+            changed = []
+            for idx, (cps_b, dig_b) in before.items():
+                if idx not in after:
+                    changed.append(idx)
+                    continue
+                cps_a, dig_a = after[idx]
+                if dig_a != dig_b:
+                    changed.append(idx)
+                else:
+                    # untouched shard: same compiled instance, same bytes
+                    assert cps_a is cps_b
+            changed += [i for i in after if i not in before]
+            assert len(set(changed)) <= 1, (
+                f"churn touched shards {sorted(set(changed))}")
+            assert sps.last_refresh["shards_reassembled"] <= 1
+
+        # REPLACE in place (same key, new object)
+        before = snapshot()
+        lib["weight-cap"] = _policy("weight-cap",
+                                    {"spec": {"weight": "<=90"}})
+        sps = inc.refresh_sharded(list(lib.values()), 2, sharded=sps)
+        assert_one_shard_changed(before)
+        _assert_partition_clean(sps)
+        _assert_device_parity(sps, docs)
+
+        # ADD
+        before = snapshot()
+        lib["team-label"] = _policy(
+            "team-label", {"metadata": {"labels": {"idx": "?*"}}})
+        sps = inc.refresh_sharded(list(lib.values()), 2, sharded=sps)
+        assert_one_shard_changed(before)
+        _assert_partition_clean(sps)
+        _assert_device_parity(sps, docs)
+
+        # REMOVE
+        before = snapshot()
+        del lib["grace-cap"]
+        sps = inc.refresh_sharded(list(lib.values()), 2, sharded=sps)
+        assert_one_shard_changed(before)
+        _assert_partition_clean(sps)
+        _assert_device_parity(sps, docs)
+
+    def test_col_maps_tile_the_live_rule_axis(self):
+        sps = shard_policies(list(_lib().values()), 3)
+        cols = np.sort(np.concatenate([sh.col_map for sh in sps.shards]))
+        np.testing.assert_array_equal(
+            cols, np.arange(sps.full.tensors.n_rules_live))
+
+    def test_evaluate_resolves_host_lane(self):
+        lib = _lib()
+        lib["self-name"] = _policy(
+            "self-name",
+            {"metadata": {"name": "{{request.object.metadata.name}}"}})
+        policies = list(lib.values())
+        sps = shard_policies(policies, 2)
+        docs = [_pod(i) for i in range(11)]
+        from kyverno_tpu.models import CompiledPolicySet
+        want = CompiledPolicySet(policies).evaluate(docs)
+        np.testing.assert_array_equal(sps.evaluate(docs), want)
+
+    def test_shard_tensor_bytes_report(self):
+        sps = shard_policies(list(_lib().values()), 2, rule_bucket=True)
+        full_bytes = tensor_nbytes(sps.full.tensors)
+        per_shard = sps.shard_tensor_bytes()
+        assert set(per_shard) == {sh.index for sh in sps.shards}
+        # each shard holds a strict subset of the rule axis; its
+        # footprint must undercut the replicated full set
+        assert all(0 < b < full_bytes for b in per_shard.values())
+
+    def test_single_shard_degenerates_to_full_layout(self):
+        sps = shard_policies(list(_lib().values()), 1)
+        assert len(sps.shards) == 1
+        docs = [_pod(i) for i in range(7)]
+        _assert_partition_clean(sps)
+        _assert_device_parity(sps, docs)
