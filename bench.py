@@ -2250,6 +2250,170 @@ def bench_config12(jax):
     }
 
 
+def bench_config13(jax):
+    """Fleet fabric A/B (round 13): multi-replica serving + partitioned
+    scanning. Admission leg: one repeat-heavy trace (no update/delete
+    churn, bounded name pool, so decision keys repeat) plays through a
+    1-replica and a 3-replica in-process fleet (build_fleet_stacks: one
+    shared FabricHub, digest-affinity router) with KTPU_FABRIC=1 — the
+    verdict digests must be identical, and a third run with no-affinity
+    routing (repeats land on *different* replicas, only the shared
+    fabric can serve them) must show a cross-replica hit rate > 0.
+
+    Scan leg: replicas model separate nodes, so each member's owned
+    ranges are scanned on an isolated scanner and timed serially; fleet
+    wall-clock is max(T_member) — the slowest node gates the sweep —
+    and aggregate throughput is total rows over that. This is the
+    honest model for a fleet (no GIL-contended fake threads inflating
+    or deflating the number). Acceptance: 1-vs-3 verdict digests
+    identical on both legs, >= 2.5x aggregate scan throughput at 3
+    members, cross-replica hit rate > 0."""
+    from kyverno_tpu.fleet import scanparts
+    from kyverno_tpu.runtime.background import BackgroundScanner
+    from kyverno_tpu.workload.replay import (build_fleet_stacks,
+                                             run_fleet,
+                                             stop_fleet_stacks)
+    from kyverno_tpu.workload.trace import synthesize
+
+    from kyverno_tpu.api.load import load_policy
+
+    docs = [
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "disallow-latest"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "validate-image-tag",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "latest tag banned",
+                                   "pattern": {"spec": {"containers": [
+                                       {"image": "!*:latest"}]}}}}]}},
+        {"apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+         "metadata": {"name": "require-team-label"},
+         "spec": {"validationFailureAction": "enforce",
+                  "background": True, "rules": [{
+                      "name": "check-team",
+                      "match": {"resources": {"kinds": ["Pod"]}},
+                      "validate": {"message": "team label required",
+                                   "pattern": {"metadata": {"labels": {
+                                       "team": "?*"}}}}}]}},
+    ]
+    pols = [load_policy(d) for d in docs]
+
+    # -------- admission leg: 1-vs-3 replica parity + shared-cache hits
+    tr = synthesize(events=400, namespaces=6, distinct_bodies=8,
+                    update_fraction=0.0, delete_fraction=0.0,
+                    name_pool=6, seed=13)
+    saved_fabric = os.environ.pop("KTPU_FABRIC", None)
+    os.environ["KTPU_FABRIC"] = "1"
+    try:
+        runs = {}
+        for label, replicas, affinity in (("r1", 1, True),
+                                          ("r3", 3, True),
+                                          ("r3_spread", 3, False)):
+            fleet = build_fleet_stacks(pols, replicas=replicas)
+            try:
+                runs[label] = run_fleet(tr, fleet, workers=8,
+                                        affinity=affinity)
+            finally:
+                stop_fleet_stacks(fleet)
+    finally:
+        if saved_fabric is None:
+            os.environ.pop("KTPU_FABRIC", None)
+        else:
+            os.environ["KTPU_FABRIC"] = saved_fabric
+    admission_digests = {r["verdict_digest"] for r in runs.values()}
+    hit_rate = runs["r3_spread"]["fabric_hit_rate"]
+
+    # -------- scan leg: leader-partitioned sweep vs one replica -------
+    scan_pols = _mesh_library(n_policies=48, rules_per=8)
+    # 24 ranges over 3 members lands each member within ~4% of a third
+    # of the rows (the scan clock is linear in the pow2-padded row
+    # bucket, so the slowest member must stay under the next bucket)
+    n_parts, members = 24, ["fleet-0", "fleet-1", "fleet-2"]
+    corpus = []
+    for i in range(5760):
+        ns = f"team-{i % 288}"
+        tag = "latest" if i % 4 == 3 else f"v{i % 7}"
+        corpus.append({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": f"pod-{i}", "namespace": ns,
+                                    "labels": {"app": f"app-{i % 9}",
+                                               "team": ns}},
+                       "spec": {"containers": [
+                           {"name": "c", "image": f"nginx:{tag}"}]}})
+
+    single = BackgroundScanner(scan_pols)
+    single.scan(corpus)                      # compile warm-up
+    t0 = time.perf_counter()
+    single.scan(corpus)
+    t_single = time.perf_counter() - t0
+    base_digest = scanparts.merge_range_digests(
+        scanparts.matrix_range_digests(single, n_parts))
+
+    assignment = scanparts.assign_partitions(members, n_parts)
+    member_times, member_rows, digests = {}, {}, []
+    for member in members:
+        owned = assignment[member]
+        mine = scanparts.partition_resources(corpus, owned, n_parts)
+        scanner = BackgroundScanner(scan_pols)
+        scanner.scan(mine)                   # per-shape compile warm-up
+        # clock the scan itself, symmetric with the single baseline;
+        # range digesting is bookkeeping on both sides, not sweep time
+        t0 = time.perf_counter()
+        scanner.scan(mine)
+        member_times[member] = time.perf_counter() - t0
+        member_rows[member] = len(mine)
+        digests.append(scanparts.matrix_range_digests(
+            scanner, n_parts, owned=owned))
+    fleet_digest = scanparts.merge_range_digests(*digests)
+    t_fleet = max(member_times.values())     # slowest node gates
+    speedup = t_single / t_fleet
+
+    met = (len(admission_digests) == 1 and hit_rate > 0
+           and fleet_digest == base_digest and speedup >= 2.5
+           and runs["r1"]["denied"] > 0
+           and not any(r["errors"] for r in runs.values()))
+    return {
+        "admission": {
+            "trace": tr.stats(),
+            "verdict_digest": next(iter(admission_digests))
+            if len(admission_digests) == 1 else sorted(admission_digests),
+            "legs": {label: {
+                "replicas": r["replicas"],
+                "achieved_per_s": r["achieved_per_s"],
+                "latency_ms_p50": r["latency_ms_p50"],
+                "latency_ms_p99": r["latency_ms_p99"],
+                "denied": r["denied"],
+                "fabric_hits": r["fabric_hits"],
+                "fabric_hit_rate": r["fabric_hit_rate"],
+                "router": {k: r["router"][k] for k in (
+                    "routed", "failovers", "exhausted")},
+            } for label, r in runs.items()},
+            "cross_replica_hit_rate": hit_rate,
+        },
+        "scan": {
+            "library_rules": 48 * 8,
+            "corpus_rows": len(corpus),
+            "partitions": n_parts,
+            "members": len(members),
+            "rows_per_member": member_rows,
+            "scan_s": {"single": round(t_single, 3),
+                       "fleet_max_member": round(t_fleet, 3),
+                       "per_member": {m: round(t, 3)
+                                      for m, t in member_times.items()}},
+            "aggregate_rows_per_s": {
+                "single": round(len(corpus) / t_single, 1),
+                "fleet": round(len(corpus) / t_fleet, 1)},
+            "speedup": round(speedup, 2),
+            "digest_parity": fleet_digest == base_digest,
+            "verdict_range_digest": base_digest,
+        },
+        "target": "1-vs-3 replica verdict digests identical; >= 2.5x "
+                  "aggregate scan throughput at 3 members; "
+                  "cross-replica cache hit rate > 0",
+        "met": bool(met),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -2271,7 +2435,8 @@ def main() -> None:
                     ("9_streaming_open_loop", bench_config9),
                     ("10_trace_replay", bench_config10),
                     ("11_chaos_storm", bench_config11),
-                    ("12_mesh_2d", bench_config12)):
+                    ("12_mesh_2d", bench_config12),
+                    ("13_fleet_fabric", bench_config13)):
         if only and name.split("_")[0] not in only:
             continue
         try:

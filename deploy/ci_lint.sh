@@ -25,6 +25,15 @@ python -m kyverno_tpu.cli lint --fail-on error "${@:-tests/policies}" || rc=1
 echo "== feature-lane lint (KT5xx: KTPU_* switch matrix closed)"
 python -m kyverno_tpu.analysis.featurelint || rc=1
 
+# The runtime smoke chain verifies behavior that only matters on a
+# build whose static gates are green; with lint already failing the
+# run is red either way, so don't burn minutes confirming it.
+if [ "$rc" -ne 0 ]; then
+    echo "ci_lint: static analysis failed; skipping runtime smoke chain" >&2
+    echo "ci_lint: FAILED" >&2
+    exit "$rc"
+fi
+
 # CI_LINT_FUZZ_CASES trims the differential fuzz for callers on a test
 # budget (the lint-CLI battery); real CI keeps the >=1000-case default.
 echo "== certifier smoke (KT4xx corpus + detector self-test + differential fuzz)"
@@ -56,6 +65,9 @@ JAX_PLATFORMS=cpu python deploy/chaos_smoke.py || rc=1
 
 echo "== mesh smoke (1D/2D verdict parity, KT305 partition, kill switch)"
 JAX_PLATFORMS=cpu python deploy/mesh_smoke.py || rc=1
+
+echo "== fleet smoke (cross-replica fabric hits, churn invalidation, 1-vs-2 parity, scan takeover, kill switch)"
+JAX_PLATFORMS=cpu python deploy/fleet_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "ci_lint: FAILED" >&2

@@ -1,0 +1,319 @@
+"""Fleet verdict-fabric smoke for CI (deploy/ci_lint.sh).
+
+Proves the PR-15 fleet contract on a repeat-heavy synthetic trace
+played through in-process replica pools (workload/replay.py
+``build_fleet_stacks`` / ``run_fleet``):
+
+1. kill switch — with ``KTPU_FABRIC=0`` a 1-replica and a 2-replica
+   fleet reproduce each other's per-event decisions exactly (allowed
+   bit + violated policy/rule attribution; the failure prose is
+   lane-dependent by design, see ``_verdict_map``) and the shared hub
+   sees nothing beyond the epoch-sync handshakes (no hits, no puts);
+2. fabric parity + sharing — with the fabric on, a 2-replica
+   no-affinity run (repeated bodies landing on *different* replicas)
+   matches the kill-switch decision map exactly and serves > 0
+   cross-replica cache hits (the affinity routing path is exercised by
+   the churn gate's 2-replica run);
+3. churn invalidation — a policy-churn trace propagates invalidation
+   fleet-wide (hub epoch bumps, rows purge) while 1-vs-2 replica
+   verdict digests stay identical;
+4. transport — ``KTPU_FABRIC_TRANSPORT=socket`` (hub behind a framed
+   loopback socket) reproduces the inproc verdict map byte-for-byte;
+5. manifests — topology-mismatched runs diff as incomparable
+   (numeric deltas suppressed) while verdict parity still compares;
+6. partitioned scan + takeover — three FleetScanCoordinators split
+   ``KTPU_SCAN_PARTITIONS`` ranges via named leases; the merged
+   per-range digests equal an unpartitioned scan's, and killing a
+   member mid-protocol reassigns its ranges to the survivors (lease
+   expiry → rendezvous reassignment → part-lease takeover) with the
+   full range set re-covered and digest parity intact.
+
+Fast by construction: CPU backend, two pattern policies, ~100 trace
+events per run. ``FLEET_SMOKE_QUICK=1`` (the double-invocation
+``test_ci_lint_script_gates_on_injected_error`` budget, same idiom as
+``CI_LINT_FUZZ_CASES``) trims the traces further and skips the socket
+gate — the socket transport keeps unit coverage in
+``tests/fleet/test_fabric.py``. Exit 0 = parity, 1 = divergence.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["KTPU_REPLAY"] = "1"
+for _var in ("KTPU_FABRIC", "KTPU_FABRIC_TRANSPORT",
+             "KTPU_SCAN_PARTITIONS"):
+    os.environ.pop(_var, None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _policy(name, pattern):
+    return {
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": name},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": f"{name} violated",
+                         "pattern": pattern},
+        }]},
+    }
+
+
+BASE_DOCS = [
+    # denies the ":latest" bodies trace.synthesize emits every 4th
+    # variant — a mixed allow/deny stream, not a constant verdict
+    _policy("no-latest", {"spec": {"containers": [{"image": "!*:latest"}]}}),
+    _policy("need-team", {"metadata": {"labels": {"team": "?*"}}}),
+]
+# churn doc: flips v1-tagged bodies from allow to deny mid-trace
+CHURN_DOC = _policy("no-v1", {"spec": {"containers": [{"image": "!*:v1"}]}})
+
+
+def _fleet_run(policies, trace, replicas, affinity=True):
+    from kyverno_tpu.workload import replay
+
+    fleet = replay.build_fleet_stacks(
+        [_load(doc) for doc in policies], replicas=replicas)
+    try:
+        out = replay.run_fleet(trace, fleet, workers=4, affinity=affinity)
+        out["topology"] = replay.current_topology(fleet)
+    finally:
+        replay.stop_fleet_stacks(fleet)
+    return out
+
+
+def _load(doc):
+    from kyverno_tpu.api.load import load_policy
+
+    return load_policy(doc)
+
+
+def _verdict_map(result):
+    """Per-event decision map: allowed bit + the sorted set of violated
+    policy/rule pairs. The raw failure TEXT is lane-dependent by design
+    (a device-decided cell emits the compact webhook form, a
+    flush-resolved host cell carries the oracle's path-qualified form,
+    and which lane answers a borderline cell is a latency-router
+    decision) — the decision and its attribution are the
+    replica-parity contract, the prose is not."""
+    import re
+
+    out = {}
+    for seq, v in result["verdicts"].items():
+        out[seq] = {"allowed": v["allowed"],
+                    "violations": sorted(set(re.findall(
+                        r"policy [\w.-]+/[\w.-]+", v.get("detail") or "")))}
+    return json.dumps(out, sort_keys=True)
+
+
+def main() -> int:  # noqa: C901 - linear gate script
+    from kyverno_tpu.fleet import scanparts
+    from kyverno_tpu.runtime import leaderelection as le_mod
+    from kyverno_tpu.runtime import metrics as metrics_mod
+    from kyverno_tpu.runtime.background import BackgroundScanner
+    from kyverno_tpu.runtime.client import FakeCluster
+    from kyverno_tpu.runtime.obs_http import handle_obs_get
+    from kyverno_tpu.workload import replay, trace as trace_mod
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        tag = "ok" if ok else "FAIL"
+        print(f"[fleet_smoke] {tag:4s} {name}" + (f" ({detail})"
+                                                  if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    quick = os.environ.get("FLEET_SMOKE_QUICK") == "1"
+    n_events = 72 if quick else 120
+    if quick:
+        print("[fleet_smoke] quick mode: trimmed traces, socket gate "
+              "skipped (unit-covered in tests/fleet)")
+
+    # repeat-heavy admission trace: no update/delete churn so decision
+    # keys repeat across events (the lane the shared fabric serves)
+    tr = trace_mod.synthesize(events=n_events, namespaces=4,
+                              distinct_bodies=6, update_fraction=0.0,
+                              delete_fraction=0.0, name_pool=4, seed=7)
+    churn_tr = trace_mod.synthesize(events=n_events, namespaces=4,
+                                    distinct_bodies=6,
+                                    update_fraction=0.0,
+                                    delete_fraction=0.0, name_pool=4,
+                                    policy_docs=[CHURN_DOC],
+                                    policy_churn_every=n_events // 2 - 10,
+                                    seed=11)
+
+    # ---- gate 1: kill switch (KTPU_FABRIC unset = off) ----------------
+    off1 = _fleet_run(BASE_DOCS, tr, replicas=1)
+    off2 = _fleet_run(BASE_DOCS, tr, replicas=2)
+    check("killswitch 1-vs-2 decision maps equal (allowed + violations)",
+          _verdict_map(off1) == _verdict_map(off2),
+          f"digest {off1['verdict_digest']}")
+    check("killswitch run saw denials", off1["denied"] > 0,
+          f"denied={off1['denied']}")
+    hub_off = off2["hub"]
+    check("killswitch hub dormant (sync handshakes only)",
+          hub_off["puts"] == 0 and hub_off["hits"] == 0
+          and hub_off["gets"] == 2,
+          f"hub={hub_off}")
+    check("killswitch runs clean",
+          not off1["errors"] and not off2["errors"])
+
+    # ---- gate 2: fabric on — parity + cross-replica sharing -----------
+    os.environ["KTPU_FABRIC"] = "1"
+    # no-affinity: repeats of one body land on different replicas, so
+    # only the shared fabric (never the local caches) can serve them
+    on2_spread = _fleet_run(BASE_DOCS, tr, replicas=2, affinity=False)
+    check("fabric-on matches kill-switch decision map",
+          _verdict_map(on2_spread) == _verdict_map(off1))
+    check("cross-replica fabric hits > 0 (no-affinity routing)",
+          on2_spread["fabric_hits"] > 0,
+          f"hits={on2_spread['fabric_hits']} "
+          f"rate={on2_spread['fabric_hit_rate']}")
+    check("hub accepted publishes", on2_spread["hub"]["puts"] > 0,
+          f"puts={on2_spread['hub']['puts']}")
+    reg = metrics_mod.registry()
+    check("kyverno_fabric_* counters live",
+          (reg.counter_total("kyverno_fabric_frames_total") or 0) > 0
+          and (reg.counter_total("kyverno_fabric_hits_total") or 0) > 0)
+    health = json.loads(handle_obs_get("/healthz")[1])
+    check("/healthz fleet block reports fabric",
+          health.get("fleet", {}).get("enabled") is True,
+          f"fleet={health.get('fleet', {}).get('enabled')}")
+
+    # ---- gate 3: churn invalidation propagation -----------------------
+    ch1 = _fleet_run(BASE_DOCS, churn_tr, replicas=1)
+    ch2 = _fleet_run(BASE_DOCS, churn_tr, replicas=2)
+    check("churn 1-vs-2 verdict digests identical",
+          ch1["verdict_digest"] == ch2["verdict_digest"],
+          f"digest {ch2['verdict_digest']}")
+    check("churn drove fleet-wide invalidation",
+          ch2["hub"]["invalidations"] > 0 and ch2["hub"]["epoch"] > 0,
+          f"invalidations={ch2['hub']['invalidations']} "
+          f"epoch={ch2['hub']['epoch']}")
+    check("churn runs clean with denials",
+          not ch1["errors"] and not ch2["errors"] and ch1["denied"] > 0,
+          f"denied={ch1['denied']}")
+
+    # ---- gate 4: socket transport parity ------------------------------
+    if not quick:
+        os.environ["KTPU_FABRIC_TRANSPORT"] = "socket"
+        sock2 = _fleet_run(BASE_DOCS, tr, replicas=2, affinity=False)
+        os.environ.pop("KTPU_FABRIC_TRANSPORT", None)
+        check("socket transport decision map equal to inproc",
+              _verdict_map(sock2) == _verdict_map(on2_spread))
+        check("socket transport served fabric traffic",
+              sock2["hub"]["frames"] > 2 and sock2["fabric_hits"] > 0,
+              f"frames={sock2['hub']['frames']} "
+              f"hits={sock2['fabric_hits']}")
+
+    # ---- gate 5: manifests — topology-aware diff ----------------------
+    m1 = replay.run_manifest(tr, [off1], topology=off1["topology"])
+    m2 = replay.run_manifest(tr, [on2_spread],
+                             topology=on2_spread["topology"])
+    diff = replay.diff_manifests(m1, m2)
+    leg = diff["legs"]["fleet_stream"]
+    check("1-vs-2 manifest diff: verdict parity compared, deltas skipped",
+          leg.get("verdict_parity") is True
+          and leg.get("skipped") == "topology mismatch"
+          and diff["topology"]["comparable"] is False,
+          f"leg={leg}")
+
+    # ---- gate 6: partitioned scan + lease takeover --------------------
+    os.environ["KTPU_SCAN_PARTITIONS"] = "5"
+    n_parts = scanparts.scan_partition_count()
+    saved = (le_mod.LEASE_DURATION_S, le_mod.RENEW_DEADLINE_S)
+    le_mod.LEASE_DURATION_S, le_mod.RENEW_DEADLINE_S = 0.25, 0.2
+    try:
+        policies = [_load(doc) for doc in BASE_DOCS]
+        resources = []
+        for i in range(40):
+            ns = f"team-{i % 8}"
+            tag = "latest" if i % 4 == 3 else f"v{i % 7}"
+            resources.append({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"pod-{i}", "namespace": ns,
+                             "labels": {"team": ns}},
+                "spec": {"containers": [{"name": "c",
+                                         "image": f"nginx:{tag}"}]}})
+
+        baseline = BackgroundScanner(policies)
+        baseline.scan(resources)
+        base_digest = scanparts.merge_range_digests(
+            scanparts.matrix_range_digests(baseline, n_parts))
+
+        cluster = FakeCluster()
+        coords = {name: scanparts.FleetScanCoordinator(
+            cluster, identity=name) for name in ("r0", "r1", "r2")}
+        scanners = {name: BackgroundScanner(policies) for name in coords}
+        for _ in range(3):   # leader elects, publishes, members enroll
+            for c in coords.values():
+                c.tick()
+        owned = {n: set(c.owned_partitions()) for n, c in coords.items()}
+        all_owned = set().union(*owned.values())
+        check("partition protocol covers full range set",
+              all_owned == set(range(n_parts))
+              and sum(len(o) for o in owned.values()) == n_parts,
+              f"owned={ {n: sorted(o) for n, o in owned.items()} }")
+
+        digests = {}
+        for name, c in coords.items():
+            _, d = scanparts.scan_partitions(
+                scanners[name], resources, c.owned_partitions(), n_parts)
+            digests[name] = d
+        check("partitioned scan digest == unpartitioned",
+              scanparts.merge_range_digests(*digests.values())
+              == base_digest, f"base={base_digest}")
+        check("per-range row gauge published",
+              any(reg.gauge_value("kyverno_scan_partition_rows",
+                                  {"range": str(p)}) is not None
+                  for p in range(n_parts)))
+
+        # crash a member that owns ranges: simply stop ticking it, so
+        # nothing renews and its member/part leases must *expire* (the
+        # hard takeover path — no graceful release). If every owner
+        # leads, leadership takeover is part of the exercise.
+        victims = [n for n, c in coords.items()
+                   if owned[n] and not c.elector.is_leader()]
+        victim = victims[0] if victims else next(
+            n for n in coords if owned[n])
+        dead_ranges = owned.pop(victim)
+        coords.pop(victim)
+        time.sleep(le_mod.LEASE_DURATION_S + 0.1)
+        for _ in range(3):   # roster shrinks, reassignment, takeover
+            for c in coords.values():
+                c.tick()
+        owned2 = {n: set(c.owned_partitions()) for n, c in coords.items()}
+        check("survivors re-cover full range set after member loss",
+              set().union(*owned2.values()) == set(range(n_parts))
+              and sum(len(o) for o in owned2.values()) == n_parts,
+              f"victim={victim} dead={sorted(dead_ranges)} "
+              f"owned={ {n: sorted(o) for n, o in owned2.items()} }")
+
+        digests2 = {}
+        for name, c in coords.items():
+            _, d = scanparts.scan_partitions(
+                scanners[name], resources, c.owned_partitions(), n_parts)
+            digests2[name] = d
+        check("post-takeover merged digest == unpartitioned (no dropped "
+              "rows)",
+              scanparts.merge_range_digests(*digests2.values())
+              == base_digest)
+        for c in coords.values():
+            c.stop()
+    finally:
+        le_mod.LEASE_DURATION_S, le_mod.RENEW_DEADLINE_S = saved
+        os.environ.pop("KTPU_SCAN_PARTITIONS", None)
+        os.environ.pop("KTPU_FABRIC", None)
+
+    if failures:
+        print(f"[fleet_smoke] FAILED: {failures}")
+        return 1
+    print("[fleet_smoke] all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,6 +121,10 @@ class BackgroundScanner:
         self.delta_stats = {"full_scans": 0, "delta_scans": 0,
                             "cols_evaluated": 0, "rows_evaluated": 0}
         self._obs = None
+        # fleet fabric client (fleet/fabric.attach_stack); a policy
+        # refresh that recompiles or drops segments purges the shared
+        # tiers fleet-wide
+        self._fabric = None
         self._apply_policies(policies)
 
     def serve_observability(self, host: str = "127.0.0.1",
@@ -180,8 +184,15 @@ class BackgroundScanner:
     def update_policies(self, policies: list) -> dict:
         """Replace the scanned policy set. With incremental compilation
         only segments whose policy object changed recompile; the refresh
-        summary (recompiled/dropped keys) seeds the next delta pass."""
-        return self._apply_policies(policies)
+        summary (recompiled/dropped keys) seeds the next delta pass —
+        and, with a fabric attached, drives fleet-wide invalidation of
+        the shared tiers (a pure-reuse refresh purges nothing)."""
+        refresh = self._apply_policies(policies)
+        if self._fabric is not None:
+            from ..fleet import fabric as fabric_mod
+
+            fabric_mod.publish_refresh(self._fabric, refresh)
+        return refresh
 
     def note_resource(self, event: str, resource: dict) -> None:
         """Resource watch feed: the row goes dirty for the next delta

@@ -232,6 +232,10 @@ class AdmissionBatcher:
         from .resourcecache import FlattenRowCache
 
         self._row_cache = FlattenRowCache(max_rows=row_cache_max)
+        # fleet fabric client (fleet/fabric.attach_stack); None = the
+        # single-replica build, and KTPU_FABRIC gates every consult even
+        # when attached
+        self._fabric = None
         # warmup seeds by population, replayed on policy change so the
         # post-update first burst finds warm XLA buckets and a primed
         # memo (re-warm runs on its own thread: warmup blocks on the
@@ -421,7 +425,13 @@ class AdmissionBatcher:
         memo rows refreshed BEFORE the next admission burst arrives.
         Coalesced — a storm of updates triggers one re-warm pass at a
         time — and run on a dedicated thread (never the flush pool:
-        warmup waits on flush-pool futures)."""
+        warmup waits on flush-pool futures). With a fabric attached the
+        churn also purges the shared decision/host tiers fleet-wide —
+        every replica's stale rows, not just ours."""
+        if self._fabric is not None:
+            from ..fleet import fabric as fabric_mod
+
+            fabric_mod.publish_policy_change(self._fabric, event, policy)
         with self._lock:
             if self._stopped or not self._warm_seeds or self._rewarm_pending:
                 return
@@ -496,8 +506,14 @@ class AdmissionBatcher:
         if key is None:
             return
         clean = all(t[2] in (Verdict.PASS, Verdict.SKIP) for t in row)
+        status = CLEAN if clean else ATTENTION
         with self._lock:
-            self._cache_store(key, CLEAN if clean else ATTENTION, row)
+            self._cache_store(key, status, row)
+        if self._fabric is not None:
+            from ..fleet import fabric as fabric_mod
+
+            fabric_mod.decision_fabric_put(self, ptype, kind, namespace,
+                                           resource, env, status, row)
 
     def cache_fingerprint(self) -> str:
         """Digest of every live decision the batcher holds: result-cache
@@ -562,6 +578,26 @@ class AdmissionBatcher:
                     rec.add_span(trace, "screen", now_pc, now_pc,
                                  lane="result_cache", status=hit[1])
                     return hit[1], hit[2]
+                if self._fabric is not None:
+                    # local miss → fleet fabric read-through: a decision
+                    # another replica already computed for this exact
+                    # (policy set, body, env) serves at cache speed here
+                    from ..fleet import fabric as fabric_mod
+
+                    far = fabric_mod.decision_fabric_get(
+                        self, ptype, kind, namespace, resource, env)
+                    if far is not None:
+                        status, row = far
+                        with self._lock:
+                            self.stats["fabric"] = (
+                                self.stats.get("fabric", 0) + 1)
+                            self.stats["clean" if status == CLEAN
+                                       else "attention"] += 1
+                            self._cache_store(cache_key, status, row)
+                        now_pc = time.perf_counter()
+                        rec.add_span(trace, "screen", now_pc, now_pc,
+                                     lane="fabric", status=status)
+                        return status, row
         fut: Future = Future()
         now = time.monotonic()
         with self._lock:
@@ -693,6 +729,12 @@ class AdmissionBatcher:
                 if cache_key is not None:
                     self._cache_store(cache_key, status, row)
             self.stats["clean" if status == CLEAN else "attention"] += 1
+        if (device_answered and cache_key is not None
+                and self._fabric is not None):
+            from ..fleet import fabric as fabric_mod
+
+            fabric_mod.decision_fabric_put(self, ptype, kind, namespace,
+                                           resource, env, status, row)
         return status, row
 
     # ----------------------------------------------------- streaming lane
@@ -936,9 +978,10 @@ class AdmissionBatcher:
                     prow = split_packed_rows(
                         cps.flatten_packed([payload]))[0]
                     if use_memo:
-                        self._row_cache.put_row(tensors.memo_space, d,
-                                                prow, tensors.n_paths,
-                                                tensors.dict_epoch)
+                        self._row_cache.put_row(
+                            tensors.memo_space, d, prow, tensors.n_paths,
+                            tensors.dict_epoch,
+                            fingerprint=tensors.fingerprint)
                 converted.append((it, prow))
             except Exception:
                 # an unconvertible payload ends the join here; it and
@@ -1059,7 +1102,8 @@ class AdmissionBatcher:
                             rows[i] = miss_rows[j]
                             cache.put_row(space, digests[i], miss_rows[j],
                                           tensors.n_paths,
-                                          tensors.dict_epoch)
+                                          tensors.dict_epoch,
+                                          fingerprint=tensors.fingerprint)
                         n_miss = len(miss_idx)
                 else:
                     miss_rows = split_packed_rows(cps.flatten_packed(
@@ -1101,7 +1145,8 @@ class AdmissionBatcher:
             for j, i in enumerate(miss_idx):
                 rows[i] = miss_rows[j]
                 cache.put_row(space, digests[i], miss_rows[j],
-                              tensors.n_paths, tensors.dict_epoch)
+                              tensors.n_paths, tensors.dict_epoch,
+                              fingerprint=tensors.fingerprint)
         return splice_packed_rows(rows), n_hits, len(miss_idx), None
 
     def _store_deferred(self, deferred) -> None:
@@ -1115,7 +1160,8 @@ class AdmissionBatcher:
         space, digests, fresh, tensors = deferred
         for d, row in zip(digests, split_packed_rows(fresh)):
             self._row_cache.put_row(space, d, row, tensors.n_paths,
-                                    tensors.dict_epoch)
+                                    tensors.dict_epoch,
+                                    fingerprint=tensors.fingerprint)
 
     def _flush(self, cps, items, is_probe: bool = False,
                flush_key=None) -> None:

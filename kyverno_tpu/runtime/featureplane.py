@@ -125,6 +125,19 @@ REGISTRY: dict[str, Switch] = {s.name: s for s in (
        "deploy/mesh_smoke.py", "",
        "mesh geometry: unset = 1D data mesh, 'PxD' = 2D policy x data, "
        "'auto' = factor the device count, '1d' = force 1D"),
+    # -- fleet plane (multi-replica verdict fabric + partitioned scan)
+    _S("KTPU_FABRIC", "kyverno_tpu.fleet.fabric",
+       "deploy/fleet_smoke.py", "0",
+       "master switch for the fleet verdict fabric (off = attached "
+       "fabric ignored; single-replica decisions bit-for-bit)"),
+    _S("KTPU_FABRIC_TRANSPORT", "kyverno_tpu.fleet.fabric",
+       "deploy/fleet_smoke.py", "inproc",
+       "fabric transport selection (inproc|socket); parity gated both "
+       "ways in fleet_smoke"),
+    _S("KTPU_SCAN_PARTITIONS", "kyverno_tpu.fleet.scanparts",
+       "deploy/fleet_smoke.py", "0",
+       "namespace-hash scan partition count (0 = unpartitioned scan; "
+       "parity gate: merged range digests == unpartitioned digest)"),
     # -- bench driver
     _S("KTPU_BENCH_CONFIGS", "bench",
        "bench.py --smoke", "",

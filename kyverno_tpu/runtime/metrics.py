@@ -1013,3 +1013,61 @@ def mesh_geometry_snapshot() -> dict:
             "platform": _MESH_GEOMETRY["platform"],
             "axes": dict(_MESH_GEOMETRY["axes"]),
             "shard_rules": dict(_MESH_GEOMETRY["shard_rules"])}
+
+
+def record_fabric_frame(registry: MetricsRegistry, op: str,
+                        tier: str) -> None:
+    """One CACHE_GET/PUT/INVALIDATE frame handled by a fabric hub."""
+    registry.inc_counter("kyverno_fabric_frames_total",
+                         {"op": op, "tier": tier or "all"})
+
+
+def record_fabric_lookup(registry: MetricsRegistry, tier: str,
+                         hit: bool) -> None:
+    """One client-side fabric lookup outcome, per cache tier. Hit rate
+    across replicas is the fabric's reason to exist — a repeated-body
+    lane with zero cross-replica hits means keys stopped being
+    content-addressed somewhere."""
+    name = ("kyverno_fabric_hits_total" if hit
+            else "kyverno_fabric_misses_total")
+    registry.inc_counter(name, {"tier": tier})
+
+
+def record_fabric_invalidation(registry: MetricsRegistry, tier: str,
+                               purged: int) -> None:
+    """One epoch-bumping invalidation and how many rows it purged."""
+    registry.inc_counter("kyverno_fabric_invalidations_total",
+                         {"tier": tier or "all"})
+    if purged:
+        registry.inc_counter("kyverno_fabric_purged_rows_total",
+                             {"tier": tier or "all"}, float(purged))
+
+
+def record_fabric_failover(registry: MetricsRegistry,
+                           replica: str) -> None:
+    """One router failover away from a replica (error, F_ERROR reply,
+    or open breaker at submit time)."""
+    registry.inc_counter("kyverno_fabric_failovers_total",
+                         {"replica": replica})
+
+
+def record_scan_partition_rows(registry: MetricsRegistry, part: int,
+                               rows: int) -> None:
+    """``kyverno_scan_partition_rows{range}`` — rows this replica scanned
+    in one partition on its last partitioned pass; the spread across
+    ranges is the namespace-hash balance an operator checks before
+    raising KTPU_SCAN_PARTITIONS."""
+    registry.set_gauge("kyverno_scan_partition_rows",
+                       {"range": str(part)}, float(rows))
+
+
+def fleet_snapshot() -> dict:
+    """The /healthz fleet block: fabric hub/client stats and scan
+    coordinator state. Import is lazy and failure-proof so /healthz
+    keeps answering on builds where the fleet plane never loaded."""
+    try:
+        from ..fleet import fabric as _fabric
+
+        return _fabric.health_snapshot()
+    except Exception:
+        return {"enabled": False}

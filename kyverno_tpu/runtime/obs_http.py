@@ -119,6 +119,9 @@ def handle_obs_get(path: str, registry=None):
             # scan-plane mesh geometry (PR 14): selected axes, device
             # inventory, per-shard rule distribution
             "mesh": metrics_mod.mesh_geometry_snapshot(),
+            # fleet plane (PR 15): fabric hub/client counters and scan
+            # partition coordinator state
+            "fleet": metrics_mod.fleet_snapshot(),
         }).encode()
         return 200, body, "application/json"
     if route == "/debug/policies":

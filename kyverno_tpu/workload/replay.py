@@ -40,11 +40,14 @@ from ..runtime.policycache import PolicyType
 from ..runtime.workqueue import WorkerQueue
 
 # v2: manifests carry an "slo" block (degradation controller state,
-# action log, shed set) and diff_manifests refuses to compare silently
-MANIFEST_SCHEMA_VERSION = 2
+# action log, shed set) and diff_manifests refuses to compare silently.
+# v3: a "topology" block (replica count, fabric switch state, scan
+# partition map) — a 3-replica fleet run and a single-replica run are
+# different systems, and diff_manifests flags them incomparable.
+MANIFEST_SCHEMA_VERSION = 3
 
 LEGS = ("webhook", "stream_json", "stream_row", "stream_block",
-        "background")
+        "background", "fleet_stream")
 
 _ADMISSION_LEGS = ("webhook", "stream_json", "stream_row", "stream_block")
 
@@ -81,6 +84,166 @@ def build_stack(policies, continuous: bool = True,
     scanner = BackgroundScanner(policies)
     return {"policy_cache": cache, "batcher": batcher, "webhook": webhook,
             "plane": plane, "scanner": scanner}
+
+
+def build_fleet_stacks(policies, replicas: int = 2,
+                       result_cache_ttl_s: float = 60.0,
+                       continuous: bool = True) -> dict:
+    """N in-process serving stacks sharing one verdict fabric hub, plus
+    a digest-affinity router over their streaming planes — the
+    multi-replica replay leg's topology. Each replica is a full
+    :func:`build_stack` (own PolicyCache/batcher/scanner) with a
+    :class:`~..fleet.fabric.FabricClient` attached; the hub is the only
+    shared state, exactly the deployment shape.
+
+    Returns ``{"hub", "server", "stacks", "clients", "router",
+    "replicas"}``. ``KTPU_FABRIC_TRANSPORT=socket`` runs the hub behind
+    a loopback :class:`~..fleet.fabric.FabricSocketServer` with one
+    framed connection per replica (the cross-process deployment shape);
+    the default ``inproc`` wires clients straight to
+    ``hub.handle_payload``. With KTPU_FABRIC off the clients are
+    attached but dormant — the router still spreads load, the caches
+    just never meet (the kill-switch parity leg in
+    deploy/fleet_smoke.py runs exactly that)."""
+    from ..fleet.fabric import (FabricClient, FabricHub,
+                                FabricSocketServer, SocketTransport,
+                                attach_stack, transport_preference)
+    from ..fleet.router import Replica, ReplicaRouter
+
+    hub = FabricHub()
+    server = None
+    if transport_preference() == "socket":
+        server = FabricSocketServer(hub)
+    stacks, clients, members = [], [], []
+    for i in range(replicas):
+        stack = build_stack(policies, continuous=continuous,
+                            result_cache_ttl_s=result_cache_ttl_s)
+        transport = (SocketTransport(server.host, server.port)
+                     if server is not None else hub.handle_payload)
+        client = FabricClient(transport, name=f"replica-{i}")
+        client.sync()
+        attach_stack(stack, client)
+        stacks.append(stack)
+        clients.append(client)
+        members.append(Replica(
+            f"replica-{i}",
+            lambda payload, plane=stack["plane"]: plane.handle_payload(
+                payload, "fleet")))
+    return {"hub": hub, "server": server, "stacks": stacks,
+            "clients": clients, "router": ReplicaRouter(members),
+            "replicas": replicas}
+
+
+def stop_fleet_stacks(fleet: dict) -> None:
+    for stack in fleet["stacks"]:
+        stack["batcher"].stop()
+    for client in fleet["clients"]:
+        client.close()
+    if fleet.get("server") is not None:
+        fleet["server"].stop()
+
+
+def run_fleet(trace, fleet: dict, speed: float | None = None,
+              workers: int = 8, affinity: bool = True) -> dict:
+    """The multi-replica admission leg: every trace event becomes a
+    stream JSON frame routed to its digest-affinity replica through the
+    :class:`~..fleet.router.ReplicaRouter` (failover and breakers
+    included), verdicts captured exactly like the single-replica
+    ``stream_json`` leg so :func:`verdict_digest` compares across
+    topologies. Policy-churn events apply to EVERY replica's policy
+    cache — a fleet shares the policy plane, and the churn is what
+    drives cross-replica fabric invalidation.
+
+    ``affinity=False`` routes by event sequence instead of body digest
+    — the no-affinity load-balancer shape, where repeated bodies land
+    on different replicas and the shared fabric (not the local caches)
+    is what serves the repeats. The verdict digest must not care."""
+    from ..api.load import load_policy
+    from ..runtime import stream_server as ss
+
+    if not featureplane.enabled("KTPU_REPLAY"):
+        raise ReplayDisabled("KTPU_REPLAY=0: replay injection disabled")
+    router = fleet["router"]
+    reg = metrics_mod.registry()
+    lock = threading.Lock()
+    verdicts: dict[int, dict] = {}
+    lats: list[float] = []
+    errors: list[str] = []
+
+    def handle(item):
+        arrival, seq, ev, body = item
+        try:
+            frame = ss.encode_json_frame(seq, admission_review(
+                ev, body, seq))
+            route_key = (str(ev.digest) if affinity
+                         else f"seq-{seq}").encode("utf-8")
+            reply = router.submit(route_key, frame)
+            _, out = ss.decode_verdict_frame(reply)
+            lat = time.perf_counter() - arrival
+            with lock:
+                verdicts[seq] = _verdict_summary("stream_json", out)
+                lats.append(lat * 1e3)
+            metrics_mod.record_replay_latency(reg, "fleet_stream", lat)
+        except Exception as exc:
+            with lock:
+                errors.append(f"{seq}: {exc!r}")
+            raise
+
+    wq = WorkerQueue(handle, workers=workers, name="replay-fleet")
+    wq.run()
+    t0 = time.perf_counter()
+    released = 0
+    for seq, ev in enumerate(trace.events):
+        if ev.op == "POLICY":
+            # the policy plane is fleet-wide: drain in-flight admissions
+            # (a frame racing the churn could land on either side on
+            # different replicas), then land the update everywhere
+            wq.drain(timeout=120.0)
+            pol = load_policy(trace.body_of(ev))
+            for stack in fleet["stacks"]:
+                stack["policy_cache"].add(pol)
+            continue
+        if speed:
+            delay = t0 + ev.ts / speed - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        wq.add((time.perf_counter(), seq, ev, trace.body_of(ev)))
+        released += 1
+    wq.drain(timeout=120.0)
+    wq.stop()
+    span = max(time.perf_counter() - t0, 1e-9)
+    metrics_mod.record_replay_events(reg, "fleet_stream",
+                                     n=wq.processed, dropped=wq.dropped)
+    lats_sorted = sorted(lats) or [0.0]
+
+    def pct(p: float) -> float:
+        return round(lats_sorted[min(len(lats_sorted) - 1,
+                                     int(p * len(lats_sorted)))], 3)
+
+    fabric_hits = sum(c.stats["hits"] for c in fleet["clients"])
+    fabric_gets = sum(c.stats["gets"] for c in fleet["clients"])
+    return {
+        "leg": "fleet_stream",
+        "speed": speed,
+        "replicas": fleet["replicas"],
+        "events": released,
+        "processed": wq.processed,
+        "dropped": wq.dropped,
+        "errors": errors[:8],
+        "duration_s": round(span, 4),
+        "achieved_per_s": round(wq.processed / span, 1),
+        "latency_ms_p50": pct(0.50),
+        "latency_ms_p99": pct(0.99),
+        "router": router.snapshot(),
+        "fabric_hits": fabric_hits,
+        "fabric_hit_rate": round(fabric_hits / fabric_gets, 4)
+        if fabric_gets else 0.0,
+        "hub": fleet["hub"].snapshot(),
+        "verdicts": verdicts,
+        "verdict_digest": verdict_digest(verdicts),
+        "denied": sum(1 for v in verdicts.values()
+                      if not v["allowed"]),
+    }
 
 
 def admission_review(ev, body: dict, seq: int) -> dict:
@@ -487,9 +650,35 @@ class ReplayDriver:
 # -------------------------------------------------------------- manifest
 
 
+def current_topology(fleet: dict | None = None) -> dict:
+    """The replica topology a run executed under. ``fleet`` (a
+    :func:`build_fleet_stacks` result) stamps the real pool and router
+    assignment; None is the single-replica process, stamped with the
+    live switch state so a fabric-on single run still differs from a
+    fabric-off one."""
+    try:
+        from ..fleet.fabric import fabric_enabled, transport_preference
+        from ..fleet.scanparts import scan_partition_count
+
+        fabric = fabric_enabled()
+        transport = transport_preference()
+        partitions = scan_partition_count()
+    except Exception:
+        fabric, transport, partitions = False, "inproc", 0
+    topo = {"replicas": 1, "fabric": fabric, "transport": transport,
+            "scan_partitions": partitions, "partition_map": {}}
+    if fleet is not None:
+        topo["replicas"] = int(fleet.get("replicas", 1))
+        router = fleet.get("router")
+        if router is not None:
+            topo["members"] = router.members()
+    return topo
+
+
 def run_manifest(trace, leg_results: list[dict],
                  path: str | None = None, note: str = "",
-                 slo: dict | None = None) -> dict:
+                 slo: dict | None = None,
+                 topology: dict | None = None) -> dict:
     """Persistable record of one replay run: trace identity + per-leg
     numbers + parity digests. Per-event verdict maps are dropped (the
     digest carries the comparison); everything kept is
@@ -498,7 +687,9 @@ def run_manifest(trace, leg_results: list[dict],
     ``slo`` stamps the degradation controller's record (state,
     transitions, engaged actions with enter/exit timestamps, shed set);
     None captures the live controller, so a run that degraded mid-way
-    carries that fact in its manifest by default."""
+    carries that fact in its manifest by default. ``topology`` stamps
+    the replica topology (:func:`current_topology`); None captures the
+    single-replica default with live switch state."""
     legs = {}
     for r in leg_results:
         slim = {k: v for k, v in r.items() if k != "verdicts"}
@@ -510,6 +701,8 @@ def run_manifest(trace, leg_results: list[dict],
             slo = controller().manifest_record()
         except Exception:
             slo = {"enabled": False, "state": "unknown"}
+    if topology is None:
+        topology = current_topology()
     manifest = {
         "schema_version": MANIFEST_SCHEMA_VERSION,
         "note": note,
@@ -517,6 +710,7 @@ def run_manifest(trace, leg_results: list[dict],
                   "meta": trace.meta, **trace.stats()},
         "legs": legs,
         "slo": slo,
+        "topology": topology,
     }
     if path:
         with open(path, "w") as f:
@@ -534,6 +728,13 @@ def diff_manifests(a: dict, b: dict) -> dict:
     if (a.get("schema_version") != MANIFEST_SCHEMA_VERSION
             or b.get("schema_version") != MANIFEST_SCHEMA_VERSION):
         raise ValueError("manifest schema_version mismatch")
+    ta, tb = a.get("topology") or {}, b.get("topology") or {}
+
+    def _topo_key(t: dict) -> tuple:
+        return (t.get("replicas", 1), bool(t.get("fabric")),
+                t.get("scan_partitions", 0))
+
+    topo_comparable = _topo_key(ta) == _topo_key(tb)
     out: dict = {
         "same_trace": a["trace"]["digest"] == b["trace"]["digest"],
         "legs": {},
@@ -542,13 +743,32 @@ def diff_manifests(a: dict, b: dict) -> dict:
         la, lb = a["legs"][leg], b["legs"][leg]
         entry: dict = {}
         if "verdict_digest" in la and "verdict_digest" in lb:
+            # verdicts must agree across topologies (that's the fleet's
+            # correctness contract) so parity always compares...
             entry["verdict_parity"] = (la["verdict_digest"]
                                        == lb["verdict_digest"])
-        for k in ("achieved_per_s", "latency_ms_p50", "latency_ms_p99",
-                  "queue_depth_max", "denied", "violations"):
-            if k in la and k in lb and isinstance(la[k], (int, float)):
-                entry[f"{k}_delta"] = round(lb[k] - la[k], 3)
+        if topo_comparable:
+            for k in ("achieved_per_s", "latency_ms_p50",
+                      "latency_ms_p99", "queue_depth_max", "denied",
+                      "violations"):
+                if (k in la and k in lb
+                        and isinstance(la[k], (int, float))):
+                    entry[f"{k}_delta"] = round(lb[k] - la[k], 3)
+        else:
+            # ...but a 3-replica fleet benchmarked against one replica
+            # is a topology change, not a regression: numeric deltas
+            # are suppressed rather than misread
+            entry["skipped"] = "topology mismatch"
         out["legs"][leg] = entry
+    out["topology"] = {
+        "a": {"replicas": ta.get("replicas", 1),
+              "fabric": bool(ta.get("fabric")),
+              "scan_partitions": ta.get("scan_partitions", 0)},
+        "b": {"replicas": tb.get("replicas", 1),
+              "fabric": bool(tb.get("fabric")),
+              "scan_partitions": tb.get("scan_partitions", 0)},
+        "comparable": topo_comparable,
+    }
     sa, sb = a.get("slo") or {}, b.get("slo") or {}
 
     def _slo_key(s: dict) -> tuple:

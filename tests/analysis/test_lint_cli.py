@@ -77,9 +77,11 @@ def test_lint_suppress_flag_drops_codes():
 def test_ci_lint_script_gates_on_injected_error(tmp_path):
     """Acceptance criterion: deploy/ci_lint.sh exits non-zero when an
     ERROR diagnostic is injected, zero on the shipped samples."""
-    # trimmed fuzz + generous timeout: the full smoke chain runs >100s
-    # per invocation on a loaded CI core and this test makes two.
-    budget = dict(timeout=600, extra_env={"CI_LINT_FUZZ_CASES": "120"})
+    # trimmed fuzz + quick fleet smoke + generous timeout: the full
+    # smoke chain runs >100s per invocation on a loaded CI core and
+    # this test makes two.
+    budget = dict(timeout=600, extra_env={"CI_LINT_FUZZ_CASES": "120",
+                                          "FLEET_SMOKE_QUICK": "1"})
     clean = _run("bash", "deploy/ci_lint.sh", **budget)
     assert clean.returncode == 0, clean.stdout + clean.stderr
     bad = tmp_path / "dead.yaml"

@@ -1,0 +1,336 @@
+"""Unit tests for fleet/fabric.py: the CACHE_* frame codec, the hub's
+epoch-scoped store (stale-put rejection, LRU bound, purge semantics),
+the client's resync protocol, the content-addressed keys, and the
+socket transport — all without a serving stack."""
+
+import json
+import threading
+
+import pytest
+
+from kyverno_tpu.fleet import fabric
+from kyverno_tpu.models import Verdict
+from kyverno_tpu.runtime.stream_server import (
+    F_CACHE_GET,
+    F_CACHE_INVALIDATE,
+    F_CACHE_MISS,
+    F_CACHE_OK,
+    F_CACHE_PUT,
+    F_ERROR,
+    decode_payload,
+    encode_payload,
+)
+
+
+# ------------------------------------------------------------- frame codec
+
+def test_get_frame_round_trip():
+    payload = fabric.encode_get(7, "decision", b"some|key")
+    ftype, req_id, body = decode_payload(payload)
+    assert (ftype, req_id) == (F_CACHE_GET, 7)
+    assert fabric.decode_get(body) == ("decision", b"some|key")
+
+
+def test_put_frame_round_trip():
+    payload = fabric.encode_put(9, 42, "flatten", b"k" * 33, b"v" * 100)
+    ftype, req_id, body = decode_payload(payload)
+    assert (ftype, req_id) == (F_CACHE_PUT, 9)
+    assert fabric.decode_put(body) == (42, "flatten", b"k" * 33,
+                                       b"v" * 100)
+
+
+def test_invalidate_frame_round_trip():
+    payload = fabric.encode_invalidate(3, "host", b"prefix|")
+    ftype, req_id, body = decode_payload(payload)
+    assert (ftype, req_id) == (F_CACHE_INVALIDATE, 3)
+    assert fabric.decode_invalidate(body) == ("host", b"prefix|")
+    # empty tier/prefix = the wildcard purge
+    _, _, body = decode_payload(fabric.encode_invalidate(4))
+    assert fabric.decode_invalidate(body) == ("", b"")
+
+
+# --------------------------------------------------------------------- hub
+
+def test_hub_get_put_round_trip():
+    hub = fabric.FabricHub()
+    epoch, value = hub.get("decision", b"k")
+    assert (epoch, value) == (0, None)
+    assert hub.put("decision", b"k", b"v", epoch=0) == (0, True)
+    assert hub.get("decision", b"k") == (0, b"v")
+    assert hub.stats["hits"] == 1 and hub.stats["misses"] == 1
+
+
+def test_hub_invalidate_purges_and_bumps_epoch():
+    hub = fabric.FabricHub()
+    hub.put("decision", b"a|1", b"x", epoch=0)
+    hub.put("decision", b"b|1", b"y", epoch=0)
+    hub.put("host", b"h", b"z", epoch=0)
+    epoch, purged = hub.invalidate("decision", b"a|")
+    assert (epoch, purged) == (1, 1)
+    assert hub.get("decision", b"a|1")[1] is None
+    assert hub.get("decision", b"b|1")[1] == b"y"
+    # wildcard: every tier, every key
+    epoch, purged = hub.invalidate()
+    assert (epoch, purged) == (2, 2)
+    assert hub.get("host", b"h")[1] is None
+
+
+def test_hub_rejects_stale_epoch_put():
+    """The read-compute-put race: a value computed against pre-churn
+    state must not land after the invalidation that purged it."""
+    hub = fabric.FabricHub()
+    hub.invalidate()                      # epoch -> 1
+    assert hub.put("decision", b"k", b"v", epoch=0) == (1, False)
+    assert hub.get("decision", b"k")[1] is None
+    assert hub.stats["stale_puts"] == 1
+    assert hub.put("decision", b"k", b"v", epoch=1) == (1, True)
+
+
+def test_hub_lru_bound():
+    hub = fabric.FabricHub(max_entries_per_tier=4)
+    for i in range(8):
+        hub.put("flatten", f"k{i}".encode(), b"v", epoch=0)
+    snap = hub.snapshot()
+    assert snap["entries"]["flatten"] == 4
+    assert hub.get("flatten", b"k0")[1] is None    # evicted
+    assert hub.get("flatten", b"k7")[1] == b"v"    # retained
+
+
+def test_hub_frame_errors():
+    hub = fabric.FabricHub()
+    # unknown frame type in the CACHE range
+    ftype, _, body = decode_payload(
+        hub.handle_payload(encode_payload(0x3F, 1, b"")))
+    assert ftype == F_ERROR and b"unknown fabric frame" in body
+    # truncated body (tier length points past the end)
+    ftype, _, _ = decode_payload(
+        hub.handle_payload(encode_payload(F_CACHE_GET, 2, b"\xff")))
+    assert ftype == F_ERROR
+    # unknown tier name
+    ftype, _, _ = decode_payload(hub.handle_payload(
+        fabric.encode_get(3, "no-such-tier", b"k")))
+    assert ftype == F_ERROR
+    assert hub.stats["errors"] == 3
+    # garbage that fails payload decode entirely
+    ftype, _, _ = decode_payload(hub.handle_payload(b""))
+    assert ftype == F_ERROR
+
+
+def test_hub_frame_protocol_replies():
+    hub = fabric.FabricHub()
+    ftype, req_id, _ = decode_payload(
+        hub.handle_payload(fabric.encode_get(5, "decision", b"k")))
+    assert (ftype, req_id) == (F_CACHE_MISS, 5)
+    ftype, _, body = decode_payload(hub.handle_payload(
+        fabric.encode_put(6, 0, "decision", b"k", b"v")))
+    assert ftype == F_CACHE_OK and body[8] == 1          # stored
+    ftype, _, body = decode_payload(
+        hub.handle_payload(fabric.encode_get(7, "decision", b"k")))
+    assert ftype == F_CACHE_OK and body[8:] == b"v"
+
+
+# ------------------------------------------------------------------ client
+
+def test_client_round_trip_and_resync():
+    hub = fabric.FabricHub()
+    c = fabric.FabricClient(hub.handle_payload, name="r0")
+    assert c.sync() == 0
+    assert c.put("decision", b"k", b"v") is True
+    assert c.get("decision", b"k") == b"v"
+    # a peer's invalidation makes this client's next put stale once...
+    fabric.FabricClient(hub.handle_payload, name="r1").invalidate()
+    assert c.put("decision", b"k", b"v2") is False
+    assert c.stats["put_rejected"] == 1
+    # ...but the rejection reply resynced the epoch: the retry lands
+    assert c.put("decision", b"k", b"v2") is True
+    assert c.get("decision", b"k") == b"v2"
+
+
+def test_client_degrades_to_miss_on_transport_failure():
+    def broken(payload):
+        raise ConnectionError("down")
+
+    c = fabric.FabricClient(broken, name="r0")
+    assert c.get("decision", b"k") is None
+    assert c.put("decision", b"k", b"v") is False
+    assert c.invalidate() == 0
+    assert c.stats["errors"] == 3
+
+
+def test_invalidation_races_concurrent_gets():
+    """Epoch invalidation under concurrent get/put traffic: no frame
+    errors, counters stay consistent, and the store finishes coherent
+    (every surviving entry readable, epoch strictly advanced)."""
+    hub = fabric.FabricHub()
+    clients = [fabric.FabricClient(hub.handle_payload, name=f"r{i}")
+               for i in range(4)]
+    for c in clients:
+        c.sync()
+    stop = threading.Event()
+    failures = []
+
+    def churn(c, base):
+        try:
+            i = 0
+            while not stop.is_set():
+                key = f"{base}|{i % 16}".encode()
+                c.put("decision", key, b"v")
+                blob = c.get("decision", key)
+                assert blob in (None, b"v")   # purged or intact, never torn
+                i += 1
+        except Exception as e:       # pragma: no cover - failure path
+            failures.append(repr(e))
+
+    def invalidator(c):
+        try:
+            while not stop.is_set():
+                c.invalidate("decision")
+        except Exception as e:       # pragma: no cover - failure path
+            failures.append(repr(e))
+
+    threads = [threading.Thread(target=churn, args=(c, f"w{i}"))
+               for i, c in enumerate(clients[:3])]
+    threads.append(threading.Thread(target=invalidator,
+                                    args=(clients[3],)))
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    assert not failures
+    snap = hub.snapshot()
+    assert snap["errors"] == 0
+    assert snap["epoch"] == snap["invalidations"]
+    assert snap["hits"] + snap["misses"] == snap["gets"]
+    stale_seen = sum(c.stats["put_rejected"] for c in clients)
+    assert snap["stale_puts"] == stale_seen
+
+
+# ---------------------------------------------------------------- socket
+
+def test_socket_transport_round_trip():
+    hub = fabric.FabricHub()
+    server = fabric.FabricSocketServer(hub)
+    try:
+        a = fabric.FabricClient(
+            fabric.SocketTransport(server.host, server.port), name="a")
+        b = fabric.FabricClient(
+            fabric.SocketTransport(server.host, server.port), name="b")
+        a.sync()
+        b.sync()
+        assert a.put("host", b"k", b"v") is True
+        assert b.get("host", b"k") == b"v"      # crossed the wire
+        assert b.invalidate("host") == 1
+        assert a.get("host", b"k") is None
+        a.close()
+        b.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------------------- keys
+
+def test_decision_key_canonicalizes_insertion_order():
+    from kyverno_tpu.api.load import load_policy
+    from kyverno_tpu.runtime.policycache import PolicyCache, PolicyType
+
+    cache = PolicyCache()
+    cache.add(load_policy({
+        "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+        "metadata": {"name": "p"},
+        "spec": {"validationFailureAction": "enforce", "rules": [{
+            "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+            "validate": {"message": "m",
+                         "pattern": {"spec": {"x": "y"}}}}]},
+    }))
+    r1 = json.loads('{"a": 1, "b": 2}')
+    r2 = json.loads('{"b": 2, "a": 1}')
+    k1 = fabric.decision_key(cache, PolicyType.VALIDATE_ENFORCE, "Pod",
+                             "ns", r1, {"operation": "CREATE"})
+    k2 = fabric.decision_key(cache, PolicyType.VALIDATE_ENFORCE, "Pod",
+                             "ns", r2, {"operation": "CREATE"})
+    assert k1 == k2 and k1 is not None
+    # unkeyable body (non-JSON value) -> None, same rule as local caches
+    assert fabric.decision_key(cache, PolicyType.VALIDATE_ENFORCE,
+                               "Pod", "ns", {"x": {1, 2}}, None) is None
+
+
+def test_host_key_requires_digests():
+    assert fabric.host_key((None, "rule", b"\x01")) is None
+    assert fabric.host_key((b"\x01", "rule", None)) is None
+    key = fabric.host_key((b"\x01", "rule", b"\x02"))
+    assert key == b"01|rule|02"
+
+
+# ----------------------------------------------------------- value codecs
+
+def test_decision_codec_round_trip():
+    row = [("pol", "rule", Verdict.FAIL, "nope"),
+           ("pol2", "r2", Verdict.PASS, "")]
+    status, out = fabric.decode_decision(
+        fabric.encode_decision("attention", row))
+    assert status == "attention"
+    assert out == row
+    assert isinstance(out[0][2], Verdict)
+
+
+def test_host_verdict_codec_expires_absolutely():
+    blob = fabric.encode_host_verdict(Verdict.PASS, "ok", ttl_s=30.0)
+    v, m, remaining = fabric.decode_host_verdict(blob)
+    assert (v, m) == (Verdict.PASS, "ok")
+    assert 29.0 < remaining <= 30.0
+    # published with its window already spent -> reads as expired
+    _, _, remaining = fabric.decode_host_verdict(
+        fabric.encode_host_verdict(Verdict.FAIL, "x", ttl_s=-1.0))
+    assert remaining <= 0
+
+
+def test_policyset_digest_is_order_and_process_stable():
+    from kyverno_tpu.api.load import load_policy
+
+    def mk(name):
+        return load_policy({
+            "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+            "metadata": {"name": name},
+            "spec": {"validationFailureAction": "enforce", "rules": [{
+                "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+                "validate": {"message": "m",
+                             "pattern": {"spec": {"k": name}}}}]},
+        })
+
+    a, b = mk("a"), mk("b")
+    assert (fabric.policyset_digest([a, b])
+            == fabric.policyset_digest([b, a]))
+    assert (fabric.policyset_digest([a])
+            != fabric.policyset_digest([a, b]))
+
+
+def test_fabric_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("KTPU_FABRIC", raising=False)
+    assert fabric.fabric_enabled() is False
+    monkeypatch.setenv("KTPU_FABRIC", "1")
+    assert fabric.fabric_enabled() is True
+    monkeypatch.setenv("KTPU_FABRIC", "0")
+    assert fabric.fabric_enabled() is False
+
+
+def test_health_snapshot_inventories_live_objects(monkeypatch):
+    monkeypatch.setenv("KTPU_FABRIC", "1")
+    hub = fabric.FabricHub()
+    client = fabric.FabricClient(hub.handle_payload, name="snapper")
+    client.sync()
+    snap = fabric.health_snapshot()
+    assert snap["enabled"] is True
+    assert any(c["name"] == "snapper" for c in snap.get("clients", ()))
+    assert snap.get("hubs")
+
+
+@pytest.mark.parametrize("tier", fabric.TIERS)
+def test_all_tiers_store_independently(tier):
+    hub = fabric.FabricHub()
+    hub.put(tier, b"k", b"v", epoch=0)
+    for other in fabric.TIERS:
+        expected = b"v" if other == tier else None
+        assert hub.get(other, b"k")[1] == expected

@@ -1,0 +1,232 @@
+"""Unit tests for fleet/router.py: rendezvous partition-map stability
+under join/leave, breaker state machine, failover on replica failure /
+F_ERROR replies, degraded-healthz deprioritization, and pool
+exhaustion."""
+
+import hashlib
+
+import pytest
+
+from kyverno_tpu.fleet.router import (
+    Replica,
+    ReplicaBreaker,
+    ReplicaRouter,
+    RouterExhausted,
+    rendezvous_rank,
+)
+from kyverno_tpu.runtime.stream_server import (
+    F_CACHE_OK,
+    F_ERROR,
+    encode_payload,
+)
+
+OK_REPLY = encode_payload(F_CACHE_OK, 1, b"fine")
+
+
+def _digests(n):
+    return [hashlib.blake2b(str(i).encode(), digest_size=16).digest()
+            for i in range(n)]
+
+
+def _ok_replica(name, log=None):
+    def submit(payload):
+        if log is not None:
+            log.append(name)
+        return OK_REPLY
+    return Replica(name, submit)
+
+
+# -------------------------------------------------------------- rendezvous
+
+def test_rendezvous_rank_deterministic_and_total():
+    names = [f"r{i}" for i in range(5)]
+    d = _digests(1)[0]
+    order = rendezvous_rank(names, d)
+    assert sorted(order) == sorted(names)
+    assert order == rendezvous_rank(list(reversed(names)), d)
+
+
+def test_partition_map_stability_under_leave():
+    """Removing one replica moves ONLY the digests it homed — every
+    other digest keeps its winner (the rendezvous property the fabric's
+    cache affinity rides on)."""
+    names = [f"r{i}" for i in range(5)]
+    digests = _digests(300)
+    before = {d: rendezvous_rank(names, d)[0] for d in digests}
+    survivors = [n for n in names if n != "r2"]
+    after = {d: rendezvous_rank(survivors, d)[0] for d in digests}
+    moved = [d for d in digests if before[d] != after[d]]
+    assert all(before[d] == "r2" for d in moved)
+    # and the displaced digests went to their previous runner-up
+    for d in moved:
+        assert after[d] == rendezvous_rank(names, d)[1]
+    # ~1/N of the keyspace moved, not a reshuffle
+    assert 0 < len(moved) < len(digests) / 2
+
+
+def test_partition_map_stability_under_join():
+    names = [f"r{i}" for i in range(4)]
+    digests = _digests(300)
+    before = {d: rendezvous_rank(names, d)[0] for d in digests}
+    after = {d: rendezvous_rank(names + ["r-new"], d)[0]
+             for d in digests}
+    moved = [d for d in digests if before[d] != after[d]]
+    assert all(after[d] == "r-new" for d in moved)
+    assert 0 < len(moved) < len(digests) / 2
+
+
+# ----------------------------------------------------------------- breaker
+
+def test_breaker_opens_after_threshold_and_probes_after_cooldown():
+    clock = [0.0]
+    b = ReplicaBreaker(threshold=3, cooldown_s=1.0,
+                       clock=lambda: clock[0])
+    for _ in range(2):
+        b.record(False)
+    assert b.state == "closed" and b.allow()
+    b.record(False)
+    assert b.state == "open"
+    assert not b.allow() and b.stats["rejected"] == 1
+    clock[0] = 1.5                       # past cooldown: one probe
+    assert b.allow() and b.state == "half_open"
+    assert not b.allow()                 # the probe owns the lane
+    b.record(True)
+    assert b.state == "closed" and b.stats["closed"] == 1
+
+
+def test_breaker_half_open_failure_reopens():
+    clock = [0.0]
+    b = ReplicaBreaker(threshold=1, cooldown_s=1.0,
+                       clock=lambda: clock[0])
+    b.record(False)
+    assert b.state == "open"
+    clock[0] = 1.0
+    assert b.allow()
+    b.record(False)                      # probe failed
+    assert b.state == "open" and b.stats["opened"] == 2
+
+
+# ------------------------------------------------------------------ router
+
+def test_submit_routes_to_rendezvous_winner():
+    log = []
+    router = ReplicaRouter([_ok_replica(f"r{i}", log)
+                            for i in range(3)])
+    d = _digests(1)[0]
+    assert router.submit(d, b"frame") == OK_REPLY
+    assert log == [rendezvous_rank(router.members(), d)[0]]
+    assert router.stats["routed"] == 1
+
+
+def test_failover_on_raising_replica():
+    log = []
+
+    def die(payload):
+        log.append("dead")
+        raise ConnectionError("replica down")
+
+    router = ReplicaRouter([Replica("dead", die),
+                            _ok_replica("alive", log)],
+                           backoff_s=0.0)
+    # find a digest homed on the dead replica so failover must engage
+    digest = next(d for d in _digests(64)
+                  if router.rank(d)[0] == "dead")
+    assert router.submit(digest, b"frame") == OK_REPLY
+    assert log == ["dead", "alive"]
+    assert router.stats["failovers"] == 1
+    snap = router.snapshot()
+    assert snap["breakers"]["dead"]["failures"] == 1
+
+
+def test_f_error_reply_counts_as_replica_failure():
+    def erroring(payload):
+        return encode_payload(F_ERROR, 1, b"shape reject")
+
+    router = ReplicaRouter([Replica("err", erroring),
+                            _ok_replica("alive")], backoff_s=0.0)
+    digest = next(d for d in _digests(64)
+                  if router.rank(d)[0] == "err")
+    assert router.submit(digest, b"frame") == OK_REPLY
+    assert router.stats["failovers"] == 1
+
+
+def test_open_breaker_skips_replica_without_submitting():
+    calls = []
+
+    def die(payload):
+        calls.append(1)
+        raise ConnectionError("down")
+
+    router = ReplicaRouter([Replica("dead", die),
+                            _ok_replica("alive")],
+                           breaker_threshold=1, backoff_s=0.0,
+                           breaker_cooldown_s=60.0)
+    digest = next(d for d in _digests(64)
+                  if router.rank(d)[0] == "dead")
+    router.submit(digest, b"f")          # failure opens the breaker
+    router.submit(digest, b"f")          # now routed around, no call
+    assert len(calls) == 1
+    # the open breaker demotes the home replica out of first pick
+    assert router.route(digest) == "alive"
+    assert router.snapshot()["breakers"]["dead"]["state"] == "open"
+
+
+def test_degraded_healthz_deprioritizes_home_replica():
+    log = []
+    degraded = Replica("home", lambda p: (log.append("home"), OK_REPLY)[1],
+                       healthz=lambda: {"status": "degraded"})
+    healthy = Replica("other", lambda p: (log.append("other"),
+                                          OK_REPLY)[1],
+                      healthz=lambda: {"status": "ok"})
+    router = ReplicaRouter([degraded, healthy], backoff_s=0.0,
+                           health_ttl_s=0.0)
+    digest = next(d for d in _digests(64)
+                  if rendezvous_rank(["home", "other"], d)[0] == "home")
+    assert router.submit(digest, b"f") == OK_REPLY
+    assert log == ["other"]              # degraded home sorted last
+    # route() agrees: the admittable runner-up is the pick
+    assert router.route(digest) == "other"
+
+
+def test_all_degraded_pool_still_answers():
+    replica = Replica("only", lambda p: OK_REPLY,
+                      healthz=lambda: {"status": "degraded"})
+    router = ReplicaRouter([replica], backoff_s=0.0, health_ttl_s=0.0)
+    assert router.submit(_digests(1)[0], b"f") == OK_REPLY
+    assert router.route(_digests(1)[0]) == "only"
+
+
+def test_exhaustion_raises():
+    def die(payload):
+        raise ConnectionError("down")
+
+    router = ReplicaRouter([Replica("a", die), Replica("b", die)],
+                           backoff_s=0.0)
+    with pytest.raises(RouterExhausted):
+        router.submit(_digests(1)[0], b"f")
+    assert router.stats["exhausted"] == 1
+    with pytest.raises(RouterExhausted):
+        ReplicaRouter([]).submit(_digests(1)[0], b"f")
+
+
+def test_bounded_retries():
+    calls = []
+
+    def die(payload):
+        calls.append(1)
+        raise ConnectionError("down")
+
+    router = ReplicaRouter([Replica(f"r{i}", die) for i in range(5)],
+                           retries=1, backoff_s=0.0)
+    with pytest.raises(RouterExhausted):
+        router.submit(_digests(1)[0], b"f")
+    assert len(calls) == 2               # retries+1 attempts, not pool size
+
+
+def test_membership_add_remove():
+    router = ReplicaRouter([_ok_replica("a")])
+    router.add(_ok_replica("b"))
+    assert router.members() == ["a", "b"]
+    router.remove("a")
+    assert router.members() == ["b"]
+    assert router.submit(_digests(1)[0], b"f") == OK_REPLY

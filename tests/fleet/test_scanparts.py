@@ -1,0 +1,204 @@
+"""Unit tests for fleet/scanparts.py: namespace partition stability,
+rendezvous assignment under join/leave, per-range digest merge parity
+against an unpartitioned scan, and the FleetScanCoordinator lease
+protocol (assignment publication, crash takeover)."""
+
+import time
+
+import pytest
+
+from kyverno_tpu.api.load import load_policy
+from kyverno_tpu.fleet import scanparts
+from kyverno_tpu.runtime import leaderelection as le
+from kyverno_tpu.runtime.background import BackgroundScanner
+from kyverno_tpu.runtime.client import FakeCluster
+
+POLICY = load_policy({
+    "apiVersion": "kyverno.io/v1", "kind": "ClusterPolicy",
+    "metadata": {"name": "no-latest"},
+    "spec": {"validationFailureAction": "enforce", "rules": [{
+        "name": "r", "match": {"resources": {"kinds": ["Pod"]}},
+        "validate": {"message": "m",
+                     "pattern": {"spec": {"containers": [
+                         {"image": "!*:latest"}]}}}}]},
+})
+
+
+def _pods(n, namespaces=6):
+    out = []
+    for i in range(n):
+        tag = "latest" if i % 3 == 0 else f"v{i % 5}"
+        out.append({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"pod-{i}",
+                                 "namespace": f"team-{i % namespaces}"},
+                    "spec": {"containers": [
+                        {"name": "c", "image": f"nginx:{tag}"}]}})
+    return out
+
+
+# -------------------------------------------------------------- partitions
+
+def test_partition_of_stable_and_in_range():
+    for ns in ("", "default", "team-3", "kube-system"):
+        p = scanparts.partition_of(ns, 8)
+        assert 0 <= p < 8
+        assert p == scanparts.partition_of(ns, 8)
+    assert scanparts.partition_of("anything", 1) == 0
+    assert scanparts.partition_of("anything", 0) == 0
+
+
+def test_partition_resources_slices_by_owned():
+    pods = _pods(30)
+    n = 4
+    slices = [scanparts.partition_resources(pods, {p}, n)
+              for p in range(n)]
+    assert sum(len(s) for s in slices) == len(pods)
+    seen = {id(r) for s in slices for r in s}
+    assert len(seen) == len(pods)        # disjoint, complete
+    # cluster-scoped (no namespace) resources land in exactly one slice
+    cluster = [{"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "x"}}]
+    hits = [p for p in range(n)
+            if scanparts.partition_resources(cluster, {p}, n)]
+    assert len(hits) == 1
+
+
+def test_assign_partitions_complete_and_stable_under_leave():
+    members = [f"m{i}" for i in range(4)]
+    n = 16
+    before = scanparts.assign_partitions(members, n)
+    assert sorted(p for parts in before.values() for p in parts) \
+        == list(range(n))
+    after = scanparts.assign_partitions(members[:-1], n)
+    assert sorted(p for parts in after.values() for p in parts) \
+        == list(range(n))
+    # survivors keep every partition they already owned
+    for m in members[:-1]:
+        assert set(before[m]) <= set(after[m])
+    # only the dead member's partitions moved
+    moved = {p for m in members[:-1] for p in after[m]
+             if p not in before[m]}
+    assert moved == set(before["m3"])
+
+
+def test_assign_partitions_empty_roster():
+    assert scanparts.assign_partitions([], 8) == {}
+
+
+def test_scan_partition_count_env(monkeypatch):
+    monkeypatch.delenv("KTPU_SCAN_PARTITIONS", raising=False)
+    assert scanparts.scan_partition_count() == 0
+    monkeypatch.setenv("KTPU_SCAN_PARTITIONS", "6")
+    assert scanparts.scan_partition_count() == 6
+    monkeypatch.setenv("KTPU_SCAN_PARTITIONS", "-2")
+    assert scanparts.scan_partition_count() == 0
+
+
+# ----------------------------------------------------------- range digests
+
+def test_merge_range_digests_conflict_raises():
+    with pytest.raises(ValueError, match="conflicting"):
+        scanparts.merge_range_digests({0: "aaaa"}, {0: "bbbb"})
+    # agreement on the same range is fine (overlapping scans)
+    assert scanparts.merge_range_digests({0: "aaaa"}, {0: "aaaa"}) \
+        == scanparts.merge_range_digests({0: "aaaa"})
+
+
+def test_partitioned_scan_digest_parity():
+    """Three replicas each scanning disjoint owned ranges reproduce an
+    unpartitioned scan's verdict matrix digest exactly — the fleet scan
+    correctness contract."""
+    n = 4
+    pods = _pods(24)
+    baseline = BackgroundScanner([POLICY])
+    baseline.scan(pods)
+    want = scanparts.merge_range_digests(
+        scanparts.matrix_range_digests(baseline, n))
+
+    assignment = scanparts.assign_partitions(["a", "b", "c"], n)
+    digests = []
+    for member, owned in assignment.items():
+        scanner = BackgroundScanner([POLICY])
+        _, d = scanparts.scan_partitions(scanner, pods, owned, n)
+        assert set(d) <= set(owned)
+        digests.append(d)
+    assert scanparts.merge_range_digests(*digests) == want
+
+
+def test_matrix_range_digests_empty_scanner():
+    scanner = BackgroundScanner([POLICY])
+    assert scanparts.matrix_range_digests(scanner, 4) == {}
+
+
+# ------------------------------------------------------------- coordinator
+
+def _settle(coords, rounds=3):
+    for _ in range(rounds):
+        for c in coords.values():
+            c.tick()
+
+
+def test_coordinator_assignment_and_coverage():
+    cluster = FakeCluster()
+    coords = {n: scanparts.FleetScanCoordinator(cluster, identity=n,
+                                                n_partitions=6)
+              for n in ("r0", "r1")}
+    try:
+        _settle(coords)
+        owned = {n: set(c.owned_partitions()) for n, c in coords.items()}
+        assert set().union(*owned.values()) == set(range(6))
+        assert sum(len(o) for o in owned.values()) == 6
+        leaders = [n for n, c in coords.items() if c.elector.is_leader()]
+        assert len(leaders) == 1
+        snap = coords[leaders[0]].snapshot()
+        assert snap["leader"] and snap["assignments_published"] >= 1
+        assert snap["assignment"]        # published roster visible
+        # the assignment ConfigMap round-trips through the cluster
+        cm = cluster.get_configmap("kyverno",
+                                   scanparts.ASSIGNMENT_CONFIGMAP)
+        assert cm["data"]["partitions"] == "6"
+    finally:
+        for c in coords.values():
+            c.stop()
+
+
+def test_coordinator_crash_takeover(monkeypatch):
+    """A member that stops ticking (crash, no release) loses its member
+    lease to expiry; the leader reassigns its ranges and the survivor's
+    part-leases take over the expired ones — full coverage restored."""
+    monkeypatch.setattr(le, "LEASE_DURATION_S", 0.15)
+    monkeypatch.setattr(le, "RENEW_DEADLINE_S", 0.1)
+    cluster = FakeCluster()
+    coords = {n: scanparts.FleetScanCoordinator(cluster, identity=n,
+                                                n_partitions=5)
+              for n in ("r0", "r1", "r2")}
+    try:
+        _settle(coords)
+        owned = {n: set(c.owned_partitions()) for n, c in coords.items()}
+        assert set().union(*owned.values()) == set(range(5))
+        victim = next(n for n, o in owned.items() if o)
+        coords.pop(victim)               # crash: no further ticks
+        time.sleep(le.LEASE_DURATION_S + 0.05)
+        _settle(coords)
+        owned2 = {n: set(c.owned_partitions())
+                  for n, c in coords.items()}
+        assert set().union(*owned2.values()) == set(range(5))
+        assert sum(len(o) for o in owned2.values()) == 5
+        # the orphaned ranges moved to survivors
+        for p in owned[victim]:
+            assert any(p in o for o in owned2.values())
+    finally:
+        for c in coords.values():
+            c.stop()
+
+
+def test_coordinator_snapshots_inventory():
+    cluster = FakeCluster()
+    c = scanparts.FleetScanCoordinator(cluster, identity="solo",
+                                       n_partitions=3)
+    try:
+        c.tick()
+        snaps = scanparts.coordinator_snapshots()
+        assert any(s["identity"] == "solo" for s in snaps)
+    finally:
+        c.stop()

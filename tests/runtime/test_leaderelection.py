@@ -110,6 +110,117 @@ def test_stop_releases_lease_for_immediate_takeover():
     assert b.try_acquire_or_renew() is True
 
 
+def test_multi_lease_acquire_and_held():
+    cluster = FakeCluster()
+    events = []
+    a = LeaderElector(cluster, identity="scanner-a",
+                      on_lease_acquired=lambda n: events.append(("+", n)),
+                      on_lease_lost=lambda n: events.append(("-", n)))
+    a.add_lease("ktpu-scan-part-0")
+    a.add_lease("ktpu-scan-part-1")
+    assert a.try_acquire_or_renew() is True
+    assert a.held() == frozenset({"kyverno", "ktpu-scan-part-0",
+                                  "ktpu-scan-part-1"})
+    assert a.is_leader() and a.is_leader("ktpu-scan-part-1")
+    assert ("+", "ktpu-scan-part-0") in events
+    # every named lease exists in the cluster under its own name
+    assert _lease(cluster, "ktpu-scan-part-0")["spec"][
+        "holderIdentity"] == "scanner-a"
+
+
+def test_named_lease_concurrent_acquisition_single_holder():
+    """Two electors (distinct primaries) contend for one shared named
+    lease concurrently: exactly one holds it per round."""
+    for seed in range(6):
+        cluster = FakeCluster()
+        a = LeaderElector(cluster, name="primary-a", identity="a")
+        b = LeaderElector(cluster, name="primary-b", identity="b")
+        a.add_lease("shared-part")
+        b.add_lease("shared-part")
+        barrier = threading.Barrier(2)
+
+        def race(elector):
+            barrier.wait()
+            elector.try_acquire_or_renew()
+
+        ta = threading.Thread(target=race, args=(a,))
+        tb = threading.Thread(target=race, args=(b,))
+        ta.start()
+        tb.start()
+        ta.join(5.0)
+        tb.join(5.0)
+        holders = [e for e in (a, b) if e.is_leader("shared-part")]
+        assert len(holders) == 1, seed
+        # both keep their own primaries regardless of the shared race
+        assert a.is_leader() and b.is_leader()
+
+
+def test_named_lease_expiry_takeover(monkeypatch):
+    """The holder of a named lease dies without releasing; after expiry
+    the peer's next round takes it over and the dead holder observes
+    the loss."""
+    monkeypatch.setattr(le, "LEASE_DURATION_S", 0.1)
+    cluster = FakeCluster()
+    lost = []
+    a = LeaderElector(cluster, name="primary-a", identity="a",
+                      on_lease_lost=lost.append)
+    b = LeaderElector(cluster, name="primary-b", identity="b")
+    a.add_lease("shared-part")
+    assert a.try_acquire_or_renew()
+    assert a.is_leader("shared-part")
+    b.add_lease("shared-part")
+    assert b.try_acquire_or_renew()
+    assert not b.is_leader("shared-part")   # lease still fresh
+    time.sleep(0.15)
+    assert b.try_acquire_or_renew()
+    assert b.is_leader("shared-part")
+    a.try_acquire_or_renew()
+    assert not a.is_leader("shared-part")
+    assert "shared-part" in lost
+    assert a.is_leader()                    # its own primary survived
+
+
+def test_drop_lease_release_enables_immediate_reacquire():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, name="primary-a", identity="a")
+    b = LeaderElector(cluster, name="primary-b", identity="b")
+    a.add_lease("shared-part")
+    b.add_lease("shared-part")
+    assert a.try_acquire_or_renew()
+    b.try_acquire_or_renew()
+    assert not b.is_leader("shared-part")
+    a.drop_lease("shared-part", release=True)
+    assert "shared-part" not in a.held()
+    # no expiry wait: the release freed the lease right now
+    assert b.try_acquire_or_renew()
+    assert b.is_leader("shared-part")
+
+
+def test_drop_primary_lease_rejected():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="a")
+    try:
+        a.drop_lease(a.name)
+    except ValueError:
+        pass
+    else:  # pragma: no cover - failure path
+        raise AssertionError("dropping the primary lease must raise")
+
+
+def test_stop_releases_all_named_leases():
+    cluster = FakeCluster()
+    a = LeaderElector(cluster, identity="a")
+    b = LeaderElector(cluster, name="primary-b", identity="b")
+    a.add_lease("part-0")
+    assert a.try_acquire_or_renew()
+    a.stop()
+    assert a.held() == frozenset()
+    b.add_lease("part-0")
+    # both the primary and the named lease are free immediately
+    assert b.try_acquire_or_renew()
+    assert b.is_leader("part-0")
+
+
 def test_run_loop_renews_and_survivor_takes_over(monkeypatch):
     """End to end on real threads with a compressed lease: the loop
     keeps the holder leading; killing its loop (no release) hands the
